@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Benchmark: GPT training throughput on the available chip(s).
+
+Trains the cookbook's GPT (reference default shape: dim 256, 8x32 heads,
+8 layers, seq 256, GPT-2 vocab — main-single.py:156-162) with the full jitted
+train step (fwd + bwd + AdamW) in bf16 on synthetic data, and reports
+tokens/sec/chip and MFU. The reference publishes no numbers (BASELINE.md), so
+`vs_baseline` is measured MFU / the driver's 35% MFU north-star.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from tpukit.model import GPTConfig
+    from tpukit.profiling import peak_flops_per_chip, train_flops_per_token
+    from tpukit.shardings import DataParallel, SingleDevice
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    n_dev = len(jax.devices())
+    strategy = DataParallel() if n_dev > 1 else SingleDevice()
+
+    seq = 256
+    per_chip_batch = 64
+    batch = per_chip_batch * n_dev
+    cfg = GPTConfig(
+        dim=256,
+        head_dim=32,
+        heads=8,
+        num_layers=8,
+        vocab_size=50257,
+        max_position_embeddings=seq,
+        compute_dtype=jnp.bfloat16,
+    )
+
+    optimizer = make_optimizer(1e-4)
+    state = create_train_state(jax.random.PRNGKey(0), cfg, optimizer)
+    shapes = jax.eval_shape(lambda: state)
+    train_step, _, state_sharding = make_step_fns(cfg, optimizer, strategy, shapes)
+    state = jax.device_put(state, state_sharding)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(batch, seq - 1)).astype(np.int32)
+    model_batch = {
+        "input_ids": ids,
+        "position_ids": np.ascontiguousarray(
+            np.broadcast_to(np.arange(seq - 1, dtype=np.int32), ids.shape)
+        ),
+        "mask": np.zeros_like(ids, dtype=bool),
+    }
+    targets = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    # warmup / compile (float() forces a real host sync — block_until_ready
+    # is insufficient on tunneled PJRT backends)
+    for _ in range(3):
+        state, loss = train_step(state, model_batch, targets)
+    float(loss)
+
+    steps = 30
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = train_step(state, model_batch, targets)
+    final_loss = float(loss)
+    elapsed = time.perf_counter() - t0
+
+    tokens = steps * batch * (seq - 1)
+    tps = tokens / elapsed
+    tps_chip = tps / n_dev
+    flops_per_token = train_flops_per_token(cfg, seq - 1)
+    peak = peak_flops_per_chip()
+    mfu = (tps_chip * flops_per_token / peak) if peak else None
+
+    result = {
+        "metric": "gpt_train_tokens_per_sec_per_chip",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4) if mfu is not None else None,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "tokens_per_sec_total": round(tps, 1),
+        "chips": n_dev,
+        "device": jax.devices()[0].device_kind,
+        "config": f"GPT-20M dim256 L8 seq256 bf16 batch{batch}, fused train step",
+        "final_loss": round(final_loss, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Recipe 2: data-parallel training.
+
+TPU-native twin of reference `main-ddp.py`. The reference wraps the model in
+`DistributedDataParallel` (main-ddp.py:55) under torchrun + NCCL
+(main-ddp.py:1-6,26); gradients are all-reduced by DDP's autograd hooks
+during backward (main-ddp.py:124) and eval metrics are explicitly
+all-reduced (main-ddp.py:159-160). Here the same capability is a 1-D `data`
+mesh with the batch sharded across it and parameters replicated: XLA emits
+the gradient all-reduce over ICI from the sharding specs — no process
+groups, no launcher, no hooks. Per-rank data sharding (DistributedSampler,
+main-ddp.py:83-84) becomes "feed the global batch, shard on the data axis";
+process-0 gating of tqdm/generate/checkpoint (main-ddp.py:106,170,180) is
+preserved for multi-host runs.
+
+Run on any number of chips: `python main-ddp.py --batch_size 64 ...`
+(batch_size is per data-shard, as in the per-rank reference loader).
+"""
+
+from tpukit.flags import parse_flags
+from tpukit.shardings import DataParallel
+from tpukit.train import fit
+
+
+def main(argv=None):
+    flags = parse_flags(argv)
+    return fit(flags, DataParallel())
+
+
+if __name__ == "__main__":
+    main()

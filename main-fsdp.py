@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Recipe 3: fully-sharded (ZeRO-3-style) training.
+
+TPU-native twin of reference `main-fsdp.py`. The reference wraps the model
+in `FullyShardedDataParallel` with `size_based_auto_wrap_policy(
+min_num_params=100)` (main-fsdp.py:60-69), sharding params and re-gathering
+them per-module in forward/backward, with grads reduce-scattered; optional
+`CPUOffload(offload_params=True)` behind `--cpu_offload` (main-fsdp.py:68,
+219). Here the same capability is GSPMD sharding: every parameter, gradient
+and optimizer-state tensor above the size threshold is sharded along the
+`data` mesh axis; XLA inserts the all-gathers and reduce-scatters. The
+consolidated end-of-training checkpoint (full state_dict gathered, rank-0
+saves, main-fsdp.py:193-200) is the default tpukit checkpoint behavior.
+
+Run: `python main-fsdp.py --batch_size 64 [--cpu_offload] ...`
+"""
+
+from tpukit.flags import parse_flags
+from tpukit.shardings import FSDP
+from tpukit.train import fit
+
+
+def main(argv=None):
+    flags = parse_flags(argv, cpu_offload=True)
+    return fit(flags, FSDP(cpu_offload=flags.cpu_offload))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Recipe 4: pipeline-parallel training.
+
+TPU-native twin of reference `main-pipe.py` (which does not run as written —
+syntax errors at main-pipe.py:63-64,72; SURVEY §2.9 — so this implements its
+documented intent). The reference builds an `nn.Sequential` of stages pinned
+to successive GPUs, embeddings on the first stage and norm+lm_head on the
+last (main-pipe.py:52-77), wraps it in GPipe-style `Pipe(chunks=num_stages)`
+(main-pipe.py:79-83) over single-process TensorPipe RPC (main-pipe.py:21-28).
+
+Here the pipeline is a `shard_map` over a `stage` mesh axis: stacked layer
+parameters shard across stages, `lax.ppermute` (XLA collective-permute over
+ICI) moves activations + the threaded mask/targets stage-to-stage, and a
+`lax.scan` runs the micro-batch schedule — no RPC, no wrapper modules, and
+the backward comes from autodiff instead of Pipe's hand-built one. The
+stage count defaults to the device count (twin of
+`num_stages = torch.cuda.device_count()`, main-pipe.py:93) and micro-batch
+count equals stage count (`chunks=num_stages`, main-pipe.py:83).
+
+Run: `python main-pipe.py --batch_size 64 --num_layers 8 ...`
+(num_layers must divide by the stage count).
+"""
+
+from tpukit.flags import parse_flags
+from tpukit.pipeline import Pipeline
+from tpukit.train import fit
+
+
+def main(argv=None):
+    flags = parse_flags(argv)
+    return fit(flags, Pipeline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Recipe 1: single-device training.
+
+TPU-native twin of reference `main-single.py`: train the GPT-style decoder LM
+on TinyStories (or the offline fixture corpus) on one device. The reference's
+`.to("cuda" if available else "cpu")` (main-single.py:21) becomes a trivial
+one-device mesh; `torch.compile` (main-single.py:38-39) becomes the always-on
+jitted train step. The entire train/eval/generate/checkpoint loop —
+duplicated per recipe in the reference — lives in `tpukit.train.fit`; this
+recipe is just flags + strategy.
+
+Run: `python main-single.py --batch_size 64 --epochs 5 ...`
+(same 12 flags as the reference CLI, main-single.py:156-167).
+"""
+
+from tpukit.flags import parse_flags
+from tpukit.shardings import SingleDevice
+from tpukit.train import fit
+
+
+def main(argv=None):
+    flags = parse_flags(argv)
+    return fit(flags, SingleDevice())
+
+
+if __name__ == "__main__":
+    main()

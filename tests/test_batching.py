@@ -1,0 +1,69 @@
+"""Goldens for prepare_batch (reference utils.py:5-39 is subtle: shift-by-one,
+-100 masking, mask inversion + last-column drop) and the loss/accuracy ops,
+cross-checked against torch where available (SURVEY §4)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from tpukit.batching import prepare_batch
+from tpukit.ops.layers import cross_entropy_loss, masked_accuracy
+
+PAD = 2
+
+
+def test_prepare_batch_golden():
+    batch = {
+        "input_ids": np.array([[5, 6, 7, PAD, PAD]], dtype=np.int64),
+        "attention_mask": np.array([[1, 1, 1, 0, 0]], dtype=np.int64),
+    }
+    model_batch, targets = prepare_batch(batch, PAD)
+
+    np.testing.assert_array_equal(model_batch["input_ids"], [[5, 6, 7, PAD]])
+    # targets: shifted by one, pad -> -100 (utils.py:22,25)
+    np.testing.assert_array_equal(targets, [[6, 7, -100, -100]])
+    # position ids arange(S-1) (utils.py:28-30)
+    np.testing.assert_array_equal(model_batch["position_ids"], [[0, 1, 2, 3]])
+    # mask inverted (True = masked) with last column dropped (utils.py:17,36)
+    np.testing.assert_array_equal(model_batch["mask"], [[False, False, False, True]])
+
+
+def test_prepare_batch_no_padding():
+    batch = {
+        "input_ids": np.array([[1, 3, 4, 5]], dtype=np.int64),
+        "attention_mask": np.ones((1, 4), dtype=np.int64),
+    }
+    model_batch, targets = prepare_batch(batch, PAD)
+    np.testing.assert_array_equal(targets, [[3, 4, 5]])
+    assert not model_batch["mask"].any()
+
+
+def test_cross_entropy_matches_torch():
+    torch = __import__("pytest").importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    logits = rng.randn(3, 7, 11).astype(np.float32)
+    targets = rng.randint(0, 11, size=(3, 7))
+    targets[0, -2:] = -100
+    targets[2, 0] = -100
+
+    ours = cross_entropy_loss(jnp.asarray(logits), jnp.asarray(targets))
+    theirs = F.cross_entropy(
+        torch.tensor(logits).view(-1, 11), torch.tensor(targets).view(-1), ignore_index=-100
+    )
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-5)
+
+
+def test_cross_entropy_all_ignored_is_finite():
+    logits = jnp.zeros((1, 3, 5))
+    targets = jnp.full((1, 3), -100)
+    assert float(cross_entropy_loss(logits, targets)) == 0.0
+
+
+def test_masked_accuracy():
+    logits = jnp.asarray(
+        np.array([[[0.0, 2.0, 0.0], [5.0, 0.0, 0.0], [0.0, 0.0, 9.0]]], dtype=np.float32)
+    )  # argmax: 1, 0, 2
+    targets = jnp.asarray(np.array([[1, 1, -100]]))
+    # valid positions: 2; correct: 1 -> 50%
+    assert float(masked_accuracy(logits, targets)) == 50.0

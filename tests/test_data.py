@@ -1,0 +1,103 @@
+"""Data pipeline tests: offline fixture determinism, tokenizer round-trip,
+transform semantics (pad to max_length, truncation), loader sharding math."""
+
+import numpy as np
+
+from tpukit.data import (
+    ArrayDataset,
+    WordTokenizer,
+    get_dataset,
+    get_tokenizer,
+    synthetic_stories,
+    transform_dataset,
+)
+from tpukit.loader import DataLoader, distributed_indices
+
+
+def test_synthetic_corpus_deterministic():
+    assert synthetic_stories(10, seed=0) == synthetic_stories(10, seed=0)
+    assert synthetic_stories(10, seed=0) != synthetic_stories(10, seed=1)
+
+
+def test_tokenizer_roundtrip():
+    tok = get_tokenizer()
+    text = 'One day, Lily went to the park. She said "What a big ball!"'
+    out = tok([text])
+    decoded = tok.decode(out["input_ids"][0])
+    assert decoded == text
+
+
+def test_tokenizer_unknown_chars_roundtrip():
+    tok = WordTokenizer(["hello world"], model_max_length=64)
+    text = "zzz qqq 123 !?"
+    assert tok.decode(tok([text])["input_ids"][0]) == text
+
+
+def test_tokenizer_padding_truncation():
+    tok = get_tokenizer()
+    tok.pad_token_id = 2  # every recipe does this (main-single.py:23)
+    out = tok(["One day, Tom saw a cat."], padding="max_length", max_length=16, truncation=True)
+    ids, mask = out["input_ids"][0], out["attention_mask"][0]
+    assert len(ids) == 16 and len(mask) == 16
+    n = sum(mask)
+    assert all(i == 2 for i in ids[n:])
+    long = tok(["word " * 100], padding="max_length", max_length=8, truncation=True)
+    assert len(long["input_ids"][0]) == 8
+
+
+def test_get_dataset_slicing():
+    train, val = get_dataset(slice_size="50%")
+    train_full, _ = get_dataset()
+    assert len(train) == len(train_full) // 2
+    train_n, _ = get_dataset(slice_size=100)
+    assert len(train_n) == 100
+    assert len(val) > 0
+
+
+def test_transform_dataset():
+    tok = get_tokenizer()
+    tok.pad_token_id = 2
+    train, _ = get_dataset(slice_size=32)
+    ds = transform_dataset(train, tok, max_length=64)
+    assert isinstance(ds, ArrayDataset)
+    assert ds.input_ids.shape == (32, 64)
+    assert ds.attention_mask.shape == (32, 64)
+    row = ds[0]
+    assert row["input_ids"].shape == (64,)
+
+
+def test_distributed_indices_partition():
+    """Twin of DistributedSampler: ranks partition (a padded copy of) the
+    dataset; same seed+epoch -> same permutation across ranks."""
+    n, world = 103, 8
+    all_idx = [distributed_indices(n, world, r, shuffle=True, seed=7, epoch=3) for r in range(world)]
+    lens = {len(a) for a in all_idx}
+    assert lens == {13}  # ceil(103/8)
+    flat = np.concatenate(all_idx)
+    assert set(flat.tolist()) == set(range(n))  # covers everything (with wrap-padding)
+
+
+def test_distributed_indices_epoch_reshuffle():
+    a = distributed_indices(64, 4, 0, shuffle=True, seed=0, epoch=0)
+    b = distributed_indices(64, 4, 0, shuffle=True, seed=0, epoch=1)
+    assert not np.array_equal(a, b)
+
+
+def test_dataloader_batching():
+    ds = ArrayDataset(
+        np.arange(40).reshape(10, 4).astype(np.int32),
+        np.ones((10, 4), dtype=np.int32),
+    )
+    loader = DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3 == len(loader)
+    assert batches[0]["input_ids"].shape == (4, 4)
+    assert batches[2]["input_ids"].shape == (2, 4)  # drop_last=False default
+
+    loader = DataLoader(ds, batch_size=4, shuffle=True, seed=0)
+    loader.set_epoch(0)
+    e0 = np.concatenate([b["input_ids"] for b in loader])
+    loader.set_epoch(1)
+    e1 = np.concatenate([b["input_ids"] for b in loader])
+    assert not np.array_equal(e0, e1)
+    assert set(map(tuple, e0)) == set(map(tuple, e1))

@@ -1,0 +1,115 @@
+"""Model-math unit tests (SURVEY §4 plan): causal masking, padding mask,
+reference quirks, parameter shapes/counts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpukit.model import GPTConfig, TransformerDecoderLM, forward, init_params
+from tpukit.model.gpt import param_count
+
+
+def _random_batch(rng, cfg, batch=2, seq=16):
+    input_ids = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+    position_ids = np.broadcast_to(np.arange(seq, dtype=np.int32), (batch, seq))
+    return jnp.asarray(input_ids), jnp.asarray(position_ids)
+
+
+def test_forward_shape_dtype(tiny_config, tiny_params, rng):
+    ids, pos = _random_batch(rng, tiny_config)
+    logits = forward(tiny_params, tiny_config, ids, pos)
+    assert logits.shape == (2, 16, tiny_config.vocab_size)
+    assert logits.dtype == tiny_config.compute_dtype
+
+
+def test_causality(tiny_config, tiny_params, rng):
+    """Changing a future token must not change logits at earlier positions."""
+    ids, pos = _random_batch(rng, tiny_config, batch=1, seq=12)
+    logits_a = forward(tiny_params, tiny_config, ids, pos)
+    ids_b = ids.at[0, 8].set((ids[0, 8] + 1) % tiny_config.vocab_size)
+    logits_b = forward(tiny_params, tiny_config, ids_b, pos)
+    np.testing.assert_allclose(logits_a[0, :8], logits_b[0, :8], atol=1e-6)
+    assert not np.allclose(logits_a[0, 8:], logits_b[0, 8:])
+
+
+def test_padding_mask_blocks_keys(tiny_config, tiny_params, rng):
+    """With the last positions marked as padding (True = masked, the inverted
+    convention of reference utils.py:36), changing those token ids must not
+    affect logits at earlier query positions."""
+    ids, pos = _random_batch(rng, tiny_config, batch=1, seq=12)
+    mask = jnp.zeros((1, 12), dtype=bool).at[0, 9:].set(True)
+    logits_a = forward(tiny_params, tiny_config, ids, pos, mask)
+    ids_b = ids.at[0, 10].set((ids[0, 10] + 3) % tiny_config.vocab_size)
+    logits_b = forward(tiny_params, tiny_config, ids_b, pos, mask)
+    np.testing.assert_allclose(logits_a[0, :9], logits_b[0, :9], atol=1e-6)
+
+
+def test_double_activation_quirk(tiny_config, tiny_params, rng):
+    """The reference applies the activation after down_proj too
+    (models/gpt.py:37-38), so the FFN output is non-negative."""
+    from tpukit.model.gpt import _apply_feed_forward
+
+    layer0 = jax.tree.map(lambda p: p[0], tiny_params["layers"])
+    x = jnp.asarray(rng.randn(2, 8, tiny_config.dim).astype(np.float32))
+    out = _apply_feed_forward(layer0, tiny_config, x, None, True)
+    assert (np.asarray(out) >= 0).all()
+
+
+def test_param_shapes_and_count(tiny_config, tiny_params):
+    cfg = tiny_config
+    p = tiny_params
+    assert p["embeddings"]["token"].shape == (cfg.vocab_size, cfg.dim)
+    assert p["embeddings"]["position"].shape == (cfg.max_position_embeddings, cfg.dim)
+    assert p["layers"]["attn"]["q"]["kernel"].shape == (cfg.num_layers, cfg.dim, cfg.inner_dim)
+    assert "bias" not in p["layers"]["attn"]["q"]  # qkv_bias=False (gpt.py:50)
+    assert "bias" in p["layers"]["attn"]["out"]  # to_out has bias (gpt.py:64)
+    assert p["lm_head"]["kernel"].shape == (cfg.dim, cfg.vocab_size)
+    assert "bias" not in p["lm_head"]  # untied, bias=False (gpt.py:219)
+
+    d, hd, h, L, v, pe, m = (
+        cfg.dim, cfg.head_dim, cfg.heads, cfg.num_layers, cfg.vocab_size,
+        cfg.max_position_embeddings, cfg.ffn_mult,
+    )
+    inner = hd * h
+    per_layer = (
+        2 * d  # norm1
+        + 3 * d * inner  # qkv
+        + inner * d + d  # out proj
+        + 2 * d  # norm2
+        + d * (d * m) + d * m  # up
+        + (d * m) * d + d  # down
+    )
+    expected = v * d + pe * d + L * per_layer + 2 * d + d * v
+    assert param_count(p) == expected
+
+
+def test_oo_veneer_matches_functional(tiny_config, tiny_params, rng):
+    model = TransformerDecoderLM(
+        dim=tiny_config.dim,
+        head_dim=tiny_config.head_dim,
+        heads=tiny_config.heads,
+        num_layers=tiny_config.num_layers,
+        vocab_size=tiny_config.vocab_size,
+        max_position_embeddings=tiny_config.max_position_embeddings,
+        compute_dtype=jnp.float32,
+    )
+    ids, pos = _random_batch(rng, tiny_config)
+    np.testing.assert_allclose(
+        model(tiny_params, ids, pos),
+        forward(tiny_params, tiny_config, ids, pos),
+        atol=0,
+    )
+
+
+def test_scan_matches_unrolled(tiny_config, tiny_params, rng):
+    """The lax.scan trunk must equal an explicit python loop over layers."""
+    from tpukit.model.gpt import apply_decoder_layer, apply_embeddings, apply_head
+
+    ids, pos = _random_batch(rng, tiny_config, batch=1, seq=10)
+    x = apply_embeddings(tiny_params, tiny_config, ids, pos)
+    for i in range(tiny_config.num_layers):
+        layer = jax.tree.map(lambda p, i=i: p[i], tiny_params["layers"])
+        x = apply_decoder_layer(layer, tiny_config, x, None)
+    unrolled = apply_head(tiny_params, tiny_config, x)
+    scanned = forward(tiny_params, tiny_config, ids, pos)
+    np.testing.assert_allclose(unrolled, scanned, atol=1e-5)

@@ -1,0 +1,49 @@
+"""Batch preparation: raw tokenized batch -> model inputs + LM targets.
+
+Twin of `prepare_batch` (reference utils.py:5-39). Semantics twinned exactly:
+
+  - targets are input_ids shifted by one: inputs `[:, :-1]`, targets `[:, 1:]`
+    (utils.py:22);
+  - target positions equal to the pad id become -100, the cross-entropy
+    ignore index (utils.py:25);
+  - position_ids are `arange(S-1)` broadcast over the batch (utils.py:28-30);
+  - the attention mask is **inverted** (`~mask`) to the "True = masked"
+    convention and its last column is dropped (utils.py:17,36).
+
+Works on host numpy; device placement happens at the jit boundary with the
+strategy's batch sharding (the TPU-native replacement for the reference's
+`.to(device, non_blocking=True)` copies, utils.py:34-38).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+
+def prepare_batch(batch: dict, pad_id: int) -> tuple[dict, np.ndarray]:
+    """Args: `batch` with `input_ids` and `attention_mask`, both `[B, S]`
+    integer arrays (numpy or anything `np.asarray` accepts).
+
+    Returns `(model_batch, targets)` where `model_batch` has keys matching the
+    model's keyword surface (`input_ids`, `position_ids`, `mask`) — the same
+    contract as reference utils.py:32-37 — and `targets` is `[B, S-1]` int32
+    with pad positions set to -100.
+    """
+    input_ids = np.asarray(batch["input_ids"])
+    attention_mask = np.asarray(batch["attention_mask"])[:, :-1]
+
+    inputs = input_ids[:, :-1].astype(np.int32)
+    targets = input_ids[:, 1:].astype(np.int32).copy()
+    targets[targets == pad_id] = IGNORE_INDEX
+
+    seq_len = inputs.shape[1]
+    position_ids = np.broadcast_to(np.arange(seq_len, dtype=np.int32), inputs.shape)
+
+    model_batch = dict(
+        input_ids=inputs,
+        position_ids=np.ascontiguousarray(position_ids),
+        mask=~attention_mask.astype(bool),
+    )
+    return model_batch, targets

@@ -1,0 +1,66 @@
+"""Checkpoint save/restore.
+
+The reference checkpoints once, at end of training, write-only, to a
+timestamped `checkpoints/checkpoint-<YYYY-mm-dd_HH-MM-SS>.pt` (reference
+main-single.py:146-151); there is **no resume path anywhere** (SURVEY §2.8).
+tpukit twins the save surface (same directory/naming scheme, process-0-only
+in distributed recipes like main-ddp.py:179-185 / main-fsdp.py:193-200) and
+adds what the reference lacks: restore, periodic step-keyed saves, and
+optimizer-state capture so a restore actually resumes training.
+
+Format: msgpack of the full train-state pytree (params + opt state + step)
+via flax.serialization. Sharded states are gathered to host before writing —
+the twin of FSDP's full `state_dict()` gather-then-rank-0-save
+(main-fsdp.py:194-200): the on-disk artifact is always consolidated
+(unsharded), so any strategy can restore any other strategy's checkpoint.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from pathlib import Path
+
+import jax
+from flax import serialization
+
+from tpukit.mesh import is_process_zero, sync_global_devices
+
+
+def _timestamp_name() -> str:
+    return "checkpoint-" + datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S") + ".msgpack"
+
+
+def save(state, directory: str | os.PathLike = "checkpoints", name: str | None = None) -> Path | None:
+    """Consolidate + write the train state. Returns the path (process 0) or
+    None (other processes). Safe to call from all processes — the gather is
+    collective, the write is process-0-only."""
+    host_state = jax.device_get(state)  # gathers sharded leaves
+    sync_global_devices("checkpoint_gathered")
+    if not is_process_zero():
+        return None
+    directory = Path(directory).resolve()
+    directory.mkdir(parents=True, exist_ok=True)
+    path = directory / (name or _timestamp_name())
+    blob = serialization.to_bytes(host_state)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_bytes(blob)
+    tmp.rename(path)  # atomic publish: no torn checkpoints on crash
+    return path
+
+
+def restore(template, path: str | os.PathLike):
+    """Restore into the structure of `template` (a freshly-initialized train
+    state). The caller re-applies the strategy's shardings by passing the
+    result through the jitted step (or `jax.device_put` with the state
+    sharding)."""
+    blob = Path(path).read_bytes()
+    return serialization.from_bytes(template, blob)
+
+
+def latest(directory: str | os.PathLike = "checkpoints") -> Path | None:
+    directory = Path(directory)
+    if not directory.is_dir():
+        return None
+    candidates = sorted(directory.glob("checkpoint-*.msgpack"))
+    return candidates[-1] if candidates else None

@@ -1,0 +1,84 @@
+"""Shared CLI flag system.
+
+The reference duplicates an identical 12-flag argparse block in every recipe
+(main-single.py:156-167, main-ddp.py:192-203, main-fsdp.py:206-219,
+main-pipe.py:225-236); here it is one dataclass + builder imported by all
+five recipes (SURVEY §5 config plan). Flag names and defaults are twinned
+exactly; `--cpu_offload` is the FSDP recipe's extra flag (main-fsdp.py:219).
+
+TPU reinterpretations (documented divergences, not silent ones):
+  - `--disable_amp`: flips the compute dtype from bfloat16 to float32. There
+    is no GradScaler twin — bf16 needs no loss scaling (the reference's
+    scaler is a no-op for bf16 anyway, main-single.py:78).
+  - `--disable_compile`: runs the train/eval steps eagerly via
+    `jax.disable_jit()` — the debugging analogue of skipping torch.compile
+    (main-single.py:38-39).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+
+@dataclasses.dataclass
+class TrainFlags:
+    batch_size: int = 64
+    epochs: int = 5
+    sequence_length: int = 256
+    dim: int = 256
+    head_dim: int = 32
+    heads: int = 8
+    num_layers: int = 8
+    learning_rate: float = 1e-4
+    dataset_slice: str = "100%"
+    num_workers: int = 4
+    disable_amp: bool = False
+    disable_compile: bool = False
+    # FSDP recipe only (main-fsdp.py:219):
+    cpu_offload: bool = False
+    # tpukit extensions (absent in the reference; see SURVEY §5 plans):
+    seed: int = 0
+    checkpoint_every: int = 0  # steps; 0 = end-of-training only (reference behavior)
+    resume: str = ""  # checkpoint path to resume from (reference has no resume path)
+    profile_dir: str = ""  # if set, jax.profiler traces land here
+    metrics_log: str = ""  # if set, JSONL step metrics land here
+
+
+# The canonical 12 flags of every reference recipe (main-single.py:156-167).
+_CORE_FLAGS = [
+    ("batch_size", int),
+    ("epochs", int),
+    ("sequence_length", int),
+    ("dim", int),
+    ("head_dim", int),
+    ("heads", int),
+    ("num_layers", int),
+    ("learning_rate", float),
+    ("dataset_slice", str),
+    ("num_workers", int),
+]
+
+
+def build_parser(cpu_offload: bool = False) -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser()
+    defaults = TrainFlags()
+    for name, typ in _CORE_FLAGS:
+        parser.add_argument(f"--{name}", type=typ, default=getattr(defaults, name))
+    parser.add_argument("--disable_amp", action="store_true")
+    parser.add_argument("--disable_compile", action="store_true")
+    if cpu_offload:
+        parser.add_argument("--cpu_offload", action="store_true")
+    parser.add_argument("--seed", type=int, default=defaults.seed)
+    parser.add_argument("--checkpoint_every", type=int, default=defaults.checkpoint_every)
+    parser.add_argument("--resume", type=str, default=defaults.resume)
+    parser.add_argument("--profile_dir", type=str, default=defaults.profile_dir)
+    parser.add_argument("--metrics_log", type=str, default=defaults.metrics_log)
+    return parser
+
+
+def parse_flags(argv=None, cpu_offload: bool = False) -> TrainFlags:
+    ns = build_parser(cpu_offload=cpu_offload).parse_args(argv)
+    kw = vars(ns)
+    kw.setdefault("cpu_offload", False)
+    return TrainFlags(**kw)

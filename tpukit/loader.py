@@ -1,0 +1,139 @@
+"""Host-side batch loading.
+
+Twin of the reference's `torch.utils.data.DataLoader` + `DistributedSampler`
+usage (main-single.py:62-75, main-ddp.py:83-100). Two pieces:
+
+- `DataLoader`: shuffling mini-batch iterator over an `ArrayDataset`,
+  reshuffling each epoch like torch's `shuffle=True` (call `set_epoch`, the
+  same contract as `DistributedSampler.set_epoch`, main-ddp.py:109).
+- `distributed_indices`: the `DistributedSampler` index math twinned exactly
+  (pad-to-even-split by wrapping, then rank-strided) for per-host sharding in
+  multi-host runs. On a single host the SPMD recipes feed the *global* batch
+  and let the batch sharding split it across devices — the TPU-native
+  replacement for per-rank loaders.
+
+`num_workers`/`pin_memory` have no TPU-native meaning for a numpy-backed
+in-memory dataset (there is no H2D pinning; transfers happen at the jit
+boundary); the flags are accepted for CLI parity. The optional native C++
+prefetching loader (tpukit/native) covers the reference's worker-process
+capability for disk-backed corpora.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator
+
+import numpy as np
+
+from tpukit.data import ArrayDataset
+
+
+def distributed_indices(
+    dataset_len: int,
+    num_replicas: int,
+    rank: int,
+    shuffle: bool = True,
+    seed: int = 0,
+    epoch: int = 0,
+    drop_last: bool = False,
+) -> np.ndarray:
+    """Twin of torch `DistributedSampler.__iter__` semantics (the mechanism
+    behind reference main-ddp.py:83-84): optionally shuffle with
+    `seed + epoch`, pad the index list by wrapping so it divides evenly
+    (unless drop_last), then take rank-strided indices."""
+    if shuffle:
+        g = np.random.RandomState(seed + epoch)
+        indices = g.permutation(dataset_len)
+    else:
+        indices = np.arange(dataset_len)
+
+    if drop_last and dataset_len % num_replicas != 0:
+        num_samples = dataset_len // num_replicas
+        total_size = num_samples * num_replicas
+        indices = indices[:total_size]
+    else:
+        num_samples = math.ceil(dataset_len / num_replicas)
+        total_size = num_samples * num_replicas
+        if total_size > dataset_len:
+            pad = total_size - dataset_len
+            indices = np.concatenate([indices, indices[:pad]])
+
+    return indices[rank:total_size:num_replicas]
+
+
+class DataLoader:
+    """Mini-batch iterator over an ArrayDataset.
+
+    `shuffle=True` reshuffles every epoch (seeded by `seed + epoch`);
+    `num_replicas`/`rank` apply the DistributedSampler sharding above.
+    Yields dict batches of numpy arrays `{input_ids, attention_mask}`.
+    Incomplete final batches are yielded (torch default drop_last=False).
+    """
+
+    def __init__(
+        self,
+        dataset: ArrayDataset,
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        num_replicas: int = 1,
+        rank: int = 0,
+        drop_last: bool = False,
+        pad_to_batch: bool = False,
+        num_workers: int = 0,  # parity only
+        pin_memory: bool = False,  # parity only
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.drop_last = drop_last
+        # pad_to_batch wraps indices so every batch is full-shape — the
+        # global-batch analogue of DistributedSampler's pad-by-wrapping
+        # (needed so a batch sharded over the `data` axis always divides).
+        self.pad_to_batch = pad_to_batch
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _indices(self) -> np.ndarray:
+        if self.num_replicas > 1:
+            return distributed_indices(
+                len(self.dataset),
+                self.num_replicas,
+                self.rank,
+                shuffle=self.shuffle,
+                seed=self.seed,
+                epoch=self.epoch,
+                drop_last=self.drop_last,
+            )
+        if self.shuffle:
+            g = np.random.RandomState(self.seed + self.epoch)
+            indices = g.permutation(len(self.dataset))
+        else:
+            indices = np.arange(len(self.dataset))
+        if self.pad_to_batch and len(indices) % self.batch_size:
+            pad = self.batch_size - len(indices) % self.batch_size
+            indices = np.concatenate([indices, indices[:pad]])
+        return indices
+
+    def __len__(self) -> int:
+        n = len(self._indices())
+        if self.drop_last:
+            return n // self.batch_size
+        return math.ceil(n / self.batch_size)
+
+    def __iter__(self) -> Iterator[dict]:
+        indices = self._indices()
+        n = len(indices)
+        stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
+        for start in range(0, stop, self.batch_size):
+            idx = indices[start : start + self.batch_size]
+            yield {
+                "input_ids": self.dataset.input_ids[idx],
+                "attention_mask": self.dataset.attention_mask[idx],
+            }

@@ -1,0 +1,85 @@
+"""Runtime initialization and device-mesh construction.
+
+TPU-native replacement for the reference's L0 comms layer (SURVEY §2.5):
+`dist.init_process_group("nccl")` + torchrun/c10d rendezvous + manual
+rank->`cuda:{rank % ndev}` binding (reference main-ddp.py:25-35, docstring
+main-ddp.py:1-6). Under JAX there is no backend string and no launcher
+incantation: the PJRT runtime owns the devices, `jax.distributed.initialize`
+does the multi-host rendezvous (driven by the TPU runtime's own metadata),
+and parallelism is expressed as a `jax.sharding.Mesh` over the device grid.
+The compiler emits the ICI/DCN collectives from sharding annotations.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_initialized = False
+
+
+def initialize_runtime() -> None:
+    """Multi-host rendezvous (twin of init_mp, reference main-ddp.py:25-31).
+
+    On a single host this is a no-op: the TPU runtime already knows its
+    topology. On multi-host deployments (JAX_COORDINATOR_ADDRESS or a TPU pod
+    environment), `jax.distributed.initialize()` wires up DCN — the
+    capability the reference delegates to torchrun + c10d rendezvous.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if jax.process_count() > 1 or os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            pass  # already initialized by the launcher/runtime
+    _initialized = True
+
+
+def is_process_zero() -> bool:
+    """Twin of the reference's `rank == 0` gating (main-ddp.py:106,170,180)."""
+    return jax.process_index() == 0
+
+
+def create_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
+    """Build a named device mesh.
+
+    `axes` maps axis name -> size, e.g. `{"data": 8}` for DP/FSDP,
+    `{"stage": 4}` for pipeline, `{"data": 2, "stage": 4}` for the 2-D
+    hybrid. A size of -1 means "all remaining devices". With `axes=None`,
+    returns a trivial 1-device mesh (the single-device recipe).
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if axes is None:
+        return Mesh(devices[:1].reshape(1), ("data",))
+
+    names = tuple(axes.keys())
+    sizes = list(axes.values())
+    n = devices.size
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by fixed axes {axes}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, have {n}")
+    return Mesh(devices[:total].reshape(sizes), names)
+
+
+def device_kind() -> str:
+    return jax.devices()[0].device_kind
+
+
+def sync_global_devices(tag: str = "barrier") -> None:
+    """Host-level sync where one is truly needed (twin of `dist.barrier()`,
+    reference main-ddp.py:176,179 — but note SPMD needs none of the
+    reference's barriers; this exists for multi-host checkpoint sequencing)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)

@@ -1,0 +1,13 @@
+"""Model zoo. The reference ships exactly one model — a GPT-style decoder LM
+re-exported at reference `models/__init__.py:1`; this package mirrors that
+surface with the pure-JAX twin."""
+
+from tpukit.model.gpt import (  # noqa: F401
+    GPTConfig,
+    TransformerDecoderLM,
+    apply_decoder_layers,
+    apply_embeddings,
+    apply_head,
+    forward,
+    init_params,
+)

@@ -1,0 +1,298 @@
+"""Pure-JAX GPT-style decoder-only language model.
+
+TPU-native twin of reference `models/gpt.py` (`TransformerDecoderLM`,
+models/gpt.py:187-231). The model is a pure function over a parameter pytree:
+`init_params(rng, config)` builds the pytree, `forward(params, config, ...)`
+computes logits. There are no modules, no wrappers — parallelism is applied
+from the outside as sharding on the pytree (see tpukit/shardings.py) or as a
+pipeline schedule over the stacked layer parameters (see tpukit/pipeline.py).
+
+Architecture (matching the reference layer by layer):
+  - Embeddings: token + learned absolute position embeddings, summed
+    (models/gpt.py:169-185). The reference's `Embeddings.__init__` reads
+    `self.dim` before assigning it (models/gpt.py:177, AttributeError);
+    the intended behavior — embed to `dim` — is implemented here.
+  - DecoderLayer, pre-LN: `x + attn(norm1(x))`, `x + ffn(norm2(x))`
+    (models/gpt.py:124-135).
+  - SelfAttention: separate q/k/v projections without bias
+    (`qkv_bias=False` default, models/gpt.py:50,60-62), output projection
+    with bias (models/gpt.py:64), scale `1/sqrt(head_dim)` (models/gpt.py:66).
+    Attention math lives in tpukit/ops/attention.py.
+  - FeedForward: up-proj x4 -> relu -> down-proj -> **relu again** -> dropout
+    (models/gpt.py:33-41). The second activation after down_proj is unusual
+    but deliberate reference behavior; twinned faithfully.
+  - Final LayerNorm then untied `lm_head = Linear(dim, vocab, bias=False)`
+    (models/gpt.py:217-219).
+  - `forward(input_ids, position_ids, mask)` twin of models/gpt.py:221-231;
+    the reference passes an undefined `x` into embeddings (models/gpt.py:227)
+    — intended `input_ids`, implemented as intended.
+
+Layer parameters are **stacked** along a leading `num_layers` axis and the
+decoder trunk is a `lax.scan` — one compiled layer body regardless of depth,
+and a layout that reshapes directly into `[stages, layers_per_stage, ...]`
+for pipeline parallelism.
+
+Numerics: parameters are float32; matmuls run in `config.compute_dtype`
+(bfloat16 by default — the TPU-native equivalent of the reference's
+`torch.autocast(dtype=bfloat16)`, main-single.py:88-90); LayerNorm/softmax/
+loss run in float32, matching autocast's op policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpukit.ops.attention import causal_attention
+from tpukit.ops.layers import dropout, layer_norm, linear
+
+Params = Any  # nested dict pytree of jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    """Model hyper-parameters.
+
+    Defaults mirror the reference CLI defaults (main-single.py:156-162):
+    dim 256, head_dim 32, heads 8, num_layers 8, seq 256, GPT-2 vocab.
+    """
+
+    dim: int = 256
+    head_dim: int = 32
+    heads: int = 8
+    num_layers: int = 8
+    vocab_size: int = 50257
+    max_position_embeddings: int = 256
+    dropout: float = 0.0
+    ffn_mult: int = 4  # reference FeedForward mult=4 (models/gpt.py:14)
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "xla"  # "xla" | "flash" (Pallas)
+
+    @property
+    def inner_dim(self) -> int:
+        return self.head_dim * self.heads
+
+    def replace(self, **kw) -> "GPTConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# --------------------------------------------------------------------------
+# Initialization.
+#
+# Distributions twin the torch defaults the reference inherits:
+#   nn.Linear   -> kernel & bias ~ U(-1/sqrt(fan_in), 1/sqrt(fan_in))
+#   nn.Embedding-> N(0, 1)
+#   nn.LayerNorm-> scale 1, bias 0
+# --------------------------------------------------------------------------
+
+
+def _linear_params(rng, fan_in: int, fan_out: int, bias: bool, dtype) -> dict:
+    bound = 1.0 / jnp.sqrt(fan_in)
+    k_rng, b_rng = jax.random.split(rng)
+    p = {"kernel": jax.random.uniform(k_rng, (fan_in, fan_out), dtype, -bound, bound)}
+    if bias:
+        p["bias"] = jax.random.uniform(b_rng, (fan_out,), dtype, -bound, bound)
+    return p
+
+
+def _layer_norm_params(dim: int, dtype) -> dict:
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def _init_decoder_layer(rng, cfg: GPTConfig) -> dict:
+    """One DecoderLayer (models/gpt.py:108-135): attn + ffn + two norms."""
+    rngs = jax.random.split(rng, 6)
+    dtype = cfg.param_dtype
+    return {
+        "norm1": _layer_norm_params(cfg.dim, dtype),
+        "attn": {
+            "q": _linear_params(rngs[0], cfg.dim, cfg.inner_dim, bias=False, dtype=dtype),
+            "k": _linear_params(rngs[1], cfg.dim, cfg.inner_dim, bias=False, dtype=dtype),
+            "v": _linear_params(rngs[2], cfg.dim, cfg.inner_dim, bias=False, dtype=dtype),
+            "out": _linear_params(rngs[3], cfg.inner_dim, cfg.dim, bias=True, dtype=dtype),
+        },
+        "norm2": _layer_norm_params(cfg.dim, dtype),
+        "ffn": {
+            "up": _linear_params(rngs[4], cfg.dim, cfg.dim * cfg.ffn_mult, bias=True, dtype=dtype),
+            "down": _linear_params(rngs[5], cfg.dim * cfg.ffn_mult, cfg.dim, bias=True, dtype=dtype),
+        },
+    }
+
+
+def init_params(rng: jax.Array, cfg: GPTConfig) -> Params:
+    """Build the full parameter pytree. Layer params are stacked: every leaf
+    under `params["layers"]` has a leading `num_layers` axis."""
+    emb_rng, pos_rng, head_rng, layers_rng = jax.random.split(rng, 4)
+    dtype = cfg.param_dtype
+    layer_rngs = jax.random.split(layers_rng, cfg.num_layers)
+    layers = jax.vmap(partial(_init_decoder_layer, cfg=cfg))(layer_rngs)
+    return {
+        "embeddings": {
+            "token": jax.random.normal(emb_rng, (cfg.vocab_size, cfg.dim), dtype),
+            "position": jax.random.normal(pos_rng, (cfg.max_position_embeddings, cfg.dim), dtype),
+        },
+        "layers": layers,
+        "norm_out": _layer_norm_params(cfg.dim, dtype),
+        "lm_head": _linear_params(head_rng, cfg.dim, cfg.vocab_size, bias=False, dtype=dtype),
+    }
+
+
+def param_count(params: Params) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+
+# --------------------------------------------------------------------------
+# Forward pass, decomposed into embed / trunk / head so the pipeline recipe
+# can place the pieces on stages (reference main-pipe.py:52-68 puts
+# embeddings on the first stage and norm+lm_head on the last).
+# --------------------------------------------------------------------------
+
+
+def apply_embeddings(params: Params, cfg: GPTConfig, input_ids, position_ids) -> jax.Array:
+    """Token + position embedding sum (models/gpt.py:180-185), cast to the
+    compute dtype."""
+    emb = params["embeddings"]
+    x = jnp.take(emb["token"], input_ids, axis=0) + jnp.take(emb["position"], position_ids, axis=0)
+    return x.astype(cfg.compute_dtype)
+
+
+def _apply_feed_forward(layer, cfg: GPTConfig, x, rng, deterministic):
+    """FeedForward (models/gpt.py:33-41): up -> relu -> down -> relu -> drop.
+    The post-down_proj activation is the reference's (unusual) behavior."""
+    h = linear(x, layer["ffn"]["up"], cfg.compute_dtype)
+    h = jax.nn.relu(h)
+    h = linear(h, layer["ffn"]["down"], cfg.compute_dtype)
+    h = jax.nn.relu(h)
+    return dropout(h, cfg.dropout, rng, deterministic)
+
+
+def _apply_attention(layer, cfg: GPTConfig, x, pad_mask, rng, deterministic):
+    """SelfAttention (models/gpt.py:68-105)."""
+    batch, seq_len = x.shape[0], x.shape[1]
+    q = linear(x, layer["attn"]["q"], cfg.compute_dtype)
+    k = linear(x, layer["attn"]["k"], cfg.compute_dtype)
+    v = linear(x, layer["attn"]["v"], cfg.compute_dtype)
+
+    split = lambda t: t.reshape(batch, seq_len, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
+    out = causal_attention(
+        split(q),
+        split(k),
+        split(v),
+        scale=1.0 / (cfg.head_dim**0.5),
+        pad_mask=pad_mask,
+        impl=cfg.attention_impl,
+    )
+    out = out.transpose(0, 2, 1, 3).reshape(batch, seq_len, cfg.inner_dim)
+    out = linear(out, layer["attn"]["out"], cfg.compute_dtype)
+    return dropout(out, cfg.dropout, rng, deterministic)
+
+
+def apply_decoder_layer(layer: Params, cfg: GPTConfig, x, pad_mask, rng=None, deterministic=True):
+    """Pre-LN block (models/gpt.py:124-135)."""
+    if rng is None:
+        attn_rng = ffn_rng = None
+    else:
+        attn_rng, ffn_rng = jax.random.split(rng)
+    h = layer_norm(x, layer["norm1"]).astype(cfg.compute_dtype)
+    x = x + _apply_attention(layer, cfg, h, pad_mask, attn_rng, deterministic)
+    h = layer_norm(x, layer["norm2"]).astype(cfg.compute_dtype)
+    x = x + _apply_feed_forward(layer, cfg, h, ffn_rng, deterministic)
+    return x
+
+
+def apply_decoder_layers(
+    stacked_layers: Params, cfg: GPTConfig, x, pad_mask, rng=None, deterministic=True
+) -> jax.Array:
+    """Sequential layer stack (models/gpt.py:161-167) as a `lax.scan` over the
+    stacked layer parameters. Works for any leading stack size, so pipeline
+    stages call it on their `[layers_per_stage, ...]` slice."""
+    num = jax.tree_util.tree_leaves(stacked_layers)[0].shape[0]
+    if rng is None:
+        rngs = jnp.zeros((num, 2), dtype=jnp.uint32)
+        use_rng = False
+    else:
+        rngs = jax.random.split(rng, num)
+        use_rng = True
+
+    def body(carry, scanned):
+        layer, layer_rng = scanned
+        out = apply_decoder_layer(
+            layer, cfg, carry, pad_mask, layer_rng if use_rng else None, deterministic
+        )
+        return out, None
+
+    x, _ = jax.lax.scan(body, x, (stacked_layers, rngs))
+    return x
+
+
+def apply_head(params: Params, cfg: GPTConfig, x) -> jax.Array:
+    """Final LayerNorm + untied lm_head (models/gpt.py:217-219,229-231)."""
+    x = layer_norm(x, params["norm_out"]).astype(cfg.compute_dtype)
+    return linear(x, params["lm_head"], cfg.compute_dtype)
+
+
+def forward(
+    params: Params,
+    cfg: GPTConfig,
+    input_ids: jax.Array,
+    position_ids: jax.Array,
+    mask: jax.Array | None = None,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Full model: logits `[B, S, vocab]` in the compute dtype.
+
+    Twin of `TransformerDecoderLM.forward` (models/gpt.py:221-231, with the
+    undefined-`x` bug fixed to the intended `input_ids`). `mask` is `[B, S]`
+    bool, True = padding (the inverted convention produced by
+    `prepare_batch`, reference utils.py:36).
+    """
+    x = apply_embeddings(params, cfg, input_ids, position_ids)
+    x = apply_decoder_layers(params["layers"], cfg, x, mask, rng, deterministic)
+    return apply_head(params, cfg, x)
+
+
+class TransformerDecoderLM:
+    """Thin OO veneer over the functional model, mirroring the reference's
+    constructor surface (models/gpt.py:187-208) for users arriving from it.
+
+    `model = TransformerDecoderLM(dim=..., ...); params = model.init(rng);
+    logits = model(params, input_ids, position_ids, mask)`.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        head_dim: int,
+        heads: int,
+        num_layers: int,
+        vocab_size: int,
+        max_position_embeddings: int,
+        dropout: float = 0.0,
+        **kw,
+    ):
+        self.config = GPTConfig(
+            dim=dim,
+            head_dim=head_dim,
+            heads=heads,
+            num_layers=num_layers,
+            vocab_size=vocab_size,
+            max_position_embeddings=max_position_embeddings,
+            dropout=dropout,
+            **kw,
+        )
+
+    @property
+    def vocab_size(self) -> int:
+        return self.config.vocab_size
+
+    def init(self, rng: jax.Array) -> Params:
+        return init_params(rng, self.config)
+
+    def __call__(self, params, input_ids, position_ids, mask=None, rng=None, deterministic=True):
+        return forward(params, self.config, input_ids, position_ids, mask, rng, deterministic)

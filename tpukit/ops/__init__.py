@@ -1,0 +1,7 @@
+from tpukit.ops.attention import causal_attention  # noqa: F401
+from tpukit.ops.layers import (  # noqa: F401
+    cross_entropy_loss,
+    dropout,
+    layer_norm,
+    linear,
+)

@@ -1,0 +1,69 @@
+"""Causal multi-head attention core.
+
+TPU-native twin of the attention math in reference `models/gpt.py:68-105`
+(`SelfAttention.forward`). Behavioral parity with two deliberate divergences,
+both flagged in the reference's own TODOs (`models/gpt.py:81-82`):
+
+- The reference materializes a full `[N, h, S, S]` additive causal mask every
+  forward (`1e9 * (tril(ones) - 1)` then `repeat`, models/gpt.py:83-88) —
+  O(N*h*S^2) memory traffic. Here the causal constraint is a broadcast
+  `jnp.where` over a `[S, S]` boolean, which XLA fuses into the logits
+  computation; no mask tensor ever hits HBM.
+- Softmax runs in float32 regardless of compute dtype (torch autocast does the
+  same for `F.softmax`, which the reference relies on at models/gpt.py:97).
+
+The padding mask convention is the reference's: `mask` is `[B, S]` boolean
+with **True = masked**, applied key-side with the dtype's most-negative finite
+value (`masked_fill(mask[:, None, None, :], finfo.min)`, models/gpt.py:93-95).
+
+A fused Pallas flash-attention kernel (tpukit/ops/pallas_attention.py) can be
+swapped in on TPU via `causal_attention(..., impl="flash")`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # twin of the reference's additive causal constant (models/gpt.py:83)
+
+
+def causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    scale: float,
+    pad_mask: jax.Array | None = None,
+    impl: str = "xla",
+) -> jax.Array:
+    """Scaled dot-product causal attention.
+
+    Args:
+      q, k, v: `[B, heads, S, head_dim]`.
+      scale: `1 / sqrt(head_dim)` (reference models/gpt.py:66).
+      pad_mask: optional `[B, S]` bool, True = position is padding (masked).
+      impl: "xla" (fused by the compiler) or "flash" (Pallas kernel on TPU).
+
+    Returns `[B, heads, S, head_dim]` in the dtype of `v`.
+    """
+    if impl == "flash":
+        from tpukit.ops.pallas_attention import flash_causal_attention
+
+        return flash_causal_attention(q, k, v, scale=scale, pad_mask=pad_mask)
+
+    seq_len = q.shape[2]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+
+    causal = jnp.tril(jnp.ones((seq_len, seq_len), dtype=jnp.bool_))
+    logits = logits + jnp.where(causal, 0.0, NEG_INF).astype(logits.dtype)[None, None]
+
+    if pad_mask is not None:
+        logits = jnp.where(
+            pad_mask[:, None, None, :],
+            jnp.finfo(logits.dtype).min,
+            logits,
+        )
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)

@@ -1,0 +1,81 @@
+"""Primitive neural-net ops shared by the model and the pipeline stages.
+
+These are the TPU-native building blocks for the reference's torch primitives
+(`nn.Linear`, `nn.LayerNorm`, `nn.Dropout`, `F.cross_entropy`). Numerics
+policy follows torch autocast semantics on which the reference relies
+(reference `main-single.py:88-96`): matmuls run in the compute dtype
+(bfloat16 by default — TPUs are bf16-native), while LayerNorm, softmax and
+the loss run in float32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100  # twin of torch F.cross_entropy ignore_index (reference main-single.py:96)
+
+
+def linear(x: jax.Array, params: dict, compute_dtype=None) -> jax.Array:
+    """y = x @ kernel + bias. kernel: [in, out]; bias optional."""
+    dtype = compute_dtype or x.dtype
+    y = jnp.matmul(x.astype(dtype), params["kernel"].astype(dtype))
+    if "bias" in params:
+        y = y + params["bias"].astype(dtype)
+    return y
+
+
+def layer_norm(x: jax.Array, params: dict, eps: float = 1e-5) -> jax.Array:
+    """LayerNorm over the last axis, computed in float32 (autocast-faithful).
+
+    Twin of `nn.LayerNorm(dim)` used at reference models/gpt.py:119,122,217.
+    Returns float32; callers cast back to the compute dtype before matmuls.
+    """
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    normed = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return normed * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+
+
+def dropout(x: jax.Array, rate: float, rng: jax.Array | None, deterministic: bool) -> jax.Array:
+    """Inverted dropout, twin of `nn.Dropout` (reference models/gpt.py:31,65).
+
+    The reference recipes never expose a dropout flag and the model default is
+    0.0, so in practice this is the identity; it exists for capability parity.
+    """
+    if deterministic or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, p=keep, shape=x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean token cross-entropy with IGNORE_INDEX masking.
+
+    Twin of `F.cross_entropy(logits.view(-1, V), targets.view(-1),
+    ignore_index=-100)` (reference main-single.py:95-96): the mean is taken
+    over non-ignored positions only. Computed in float32.
+    """
+    logits = logits.astype(jnp.float32)
+    valid = targets != IGNORE_INDEX
+    safe_targets = jnp.where(valid, targets, 0)
+    logps = jax.nn.log_softmax(logits, axis=-1)
+    token_loss = -jnp.take_along_axis(logps, safe_targets[..., None], axis=-1)[..., 0]
+    token_loss = jnp.where(valid, token_loss, 0.0)
+    denom = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(token_loss) / denom
+
+
+def masked_accuracy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Accuracy over non-ignored positions, x100.
+
+    Twin of the eval metric at reference main-single.py:128-131:
+    `(logits.argmax(-1)[mask] == targets[mask]).float().mean() * 100`.
+    """
+    valid = targets != IGNORE_INDEX
+    preds = jnp.argmax(logits, axis=-1)
+    correct = jnp.where(valid, preds == targets, False)
+    denom = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(correct) / denom * 100.0

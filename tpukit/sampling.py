@@ -1,0 +1,79 @@
+"""Greedy (argmax) text generation.
+
+Twin of `generate` (reference utils.py:42-91): greedy decoding, at most
+`max_new_tokens` (default 20) new tokens, stop *before* appending when the
+model emits EOS (utils.py:67-68), decode with special tokens skipped
+(utils.py:91). The reference's prompt handling — tokenize with truncation to
+max_length=256 (utils.py:57) — is kept.
+
+TPU-native redesign of the loop itself: the reference re-forwards a *growing*
+sequence each step via `torch.cat` (utils.py:63-87), which under jit would
+recompile at every length. Here the sequence lives in a fixed
+`[1, prompt + max_new_tokens]` buffer and the whole decode loop is a single
+jitted `lax.while_loop`: one compile per prompt length, zero host round-trips
+inside the loop. Because attention is causal and the model is called without
+a padding mask (as in the reference, utils.py:64), the trailing unwritten
+buffer positions cannot influence the logits at the current position, so the
+fixed-buffer decode is token-for-token equivalent to the growing-buffer one.
+
+Like the reference, there is no KV cache — each step re-runs the full
+forward. A cached decode path is a later optimization; parity first.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpukit.model import gpt
+
+
+@partial(jax.jit, static_argnames=("cfg", "prompt_len", "max_new_tokens", "eos_id"))
+def _decode_loop(params, cfg: gpt.GPTConfig, buf, prompt_len: int, max_new_tokens: int, eos_id: int):
+    """Returns (buf, final_length). buf: [1, prompt_len + max_new_tokens]."""
+    total = buf.shape[1]
+    position_ids = jnp.broadcast_to(jnp.arange(total, dtype=jnp.int32), buf.shape)
+
+    def cond(carry):
+        _, cur, done = carry
+        return jnp.logical_and(~done, cur < total)
+
+    def body(carry):
+        buf, cur, _ = carry
+        logits = gpt.forward(params, cfg, buf, position_ids)
+        next_token = jnp.argmax(logits[0, cur - 1].astype(jnp.float32), axis=-1).astype(buf.dtype)
+        done = next_token == eos_id
+        # Only append when not EOS — the reference breaks before appending
+        # (utils.py:67-68), so EOS never enters the sequence.
+        new_buf = jnp.where(done, buf, buf.at[0, cur].set(next_token))
+        new_cur = jnp.where(done, cur, cur + 1)
+        return (new_buf, new_cur, done)
+
+    buf, cur, _ = jax.lax.while_loop(cond, body, (buf, jnp.int32(prompt_len), jnp.bool_(False)))
+    return buf, cur
+
+
+def generate(
+    params,
+    cfg: gpt.GPTConfig,
+    prompt: str,
+    tokenizer,
+    max_new_tokens: int = 20,
+) -> str:
+    """Greedy-decode a continuation of `prompt`. See module docstring."""
+    encoded = tokenizer([prompt], truncation=True, max_length=256)
+    ids = np.asarray(encoded["input_ids"][0], dtype=np.int32)
+    prompt_len = int(ids.shape[0])
+
+    buf = np.zeros((1, prompt_len + max_new_tokens), dtype=np.int32)
+    buf[0, :prompt_len] = ids
+
+    eos = tokenizer.eos_token_id
+    buf, length = _decode_loop(
+        params, cfg, jnp.asarray(buf), prompt_len, max_new_tokens, int(eos)
+    )
+    out_ids = np.asarray(buf)[0, : int(length)]
+    return tokenizer.decode(out_ids, skip_special_tokens=True)

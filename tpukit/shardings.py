@@ -1,0 +1,187 @@
+"""Parallelism strategies as mesh + sharding rules.
+
+This is the TPU-native re-design of the reference's L1 layer (SURVEY §2.4):
+where the reference wraps the model object (`DDP(model)` main-ddp.py:55,
+`FSDP(model, ...)` main-fsdp.py:64-69, `Pipe(...)` main-pipe.py:79-83), here
+a *strategy object* owns a `Mesh` and emits `NamedSharding`s for the train
+state and the batch. `jax.jit` + GSPMD then inserts the collectives the
+reference got from NCCL:
+
+  - DataParallel: params/opt-state replicated, batch sharded on the `data`
+    axis -> XLA emits a gradient all-reduce over ICI (the twin of DDP's
+    bucketed NCCL all-reduce fired by autograd hooks, main-ddp.py:55,124).
+  - FSDP: every tensor of params/grads/opt-state >= `min_shard_size` elements
+    is sharded along its largest divisible axis -> XLA emits per-tensor
+    all-gather (forward/backward) and reduce-scatter (grad) — the twin of
+    FullyShardedDataParallel with `size_based_auto_wrap_policy(
+    min_num_params=100)` (main-fsdp.py:60-69), where the wrap threshold
+    becomes a shard-size threshold. `cpu_offload=True` pins the sharded
+    params/opt-state to host memory (twin of `CPUOffload(offload_params=
+    True)`, main-fsdp.py:68).
+  - Pipeline strategies live in tpukit/pipeline.py (they need a schedule,
+    not just shardings) and subclass `Strategy`.
+
+Every strategy also carries the default loss computation; the pipeline
+overrides it with the micro-batched schedule.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpukit import mesh as mesh_lib
+from tpukit.model import gpt
+from tpukit.ops.layers import cross_entropy_loss, masked_accuracy
+
+
+def _sharding_tree(mesh: Mesh, spec_fn, tree_shapes):
+    """Map `spec_fn(shape) -> PartitionSpec` over a pytree of ShapeDtypeStructs
+    (or arrays), returning NamedShardings."""
+    return jax.tree.map(lambda leaf: NamedSharding(mesh, spec_fn(leaf.shape)), tree_shapes)
+
+
+class Strategy:
+    """Base: single-device (twin of main-single.py: plain `.to(device)`,
+    main-single.py:21,33 — here, a trivial 1-device mesh)."""
+
+    name = "single"
+
+    def __init__(self, mesh: Mesh | None = None):
+        self.mesh = mesh if mesh is not None else mesh_lib.create_mesh(None)
+
+    # -- sharding rules ----------------------------------------------------
+
+    def param_spec(self, shape: tuple[int, ...]) -> P:
+        return P()
+
+    def batch_spec(self) -> P:
+        return P()
+
+    def state_sharding(self, state_shapes):
+        return _sharding_tree(self.mesh, self.param_spec, state_shapes)
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec())
+
+    def to_compute(self, state):
+        """Hook run at the top of each jitted step: move offloaded state into
+        device memory. Identity unless a strategy offloads (FSDP
+        cpu_offload)."""
+        return state
+
+    def replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    # -- loss --------------------------------------------------------------
+
+    def loss_fn(self, params, cfg: gpt.GPTConfig, batch, targets, with_accuracy: bool = False):
+        """Default forward + masked CE (+ masked accuracy for eval).
+
+        Under a sharded batch this single jitted function IS the distributed
+        step: the mean over the global batch is the twin of DDP's gradient
+        all-reduce and of the explicit eval `dist.all_reduce(..., AVG)`
+        (main-ddp.py:159-160) — GSPMD inserts the psum.
+        """
+        logits = gpt.forward(
+            params, cfg, batch["input_ids"], batch["position_ids"], batch["mask"]
+        )
+        loss = cross_entropy_loss(logits, targets)
+        accuracy = masked_accuracy(logits, targets) if with_accuracy else jnp.float32(0)
+        return loss, accuracy
+
+    def describe(self) -> str:
+        return f"{self.name} over mesh {dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}"
+
+
+class SingleDevice(Strategy):
+    name = "single"
+
+
+class DataParallel(Strategy):
+    """Twin of the DDP recipe's parallelism (main-ddp.py:55): batch sharded
+    over `data`, params replicated. The gradient psum is emitted by XLA from
+    the replicated-param + sharded-batch specs."""
+
+    name = "ddp"
+
+    def __init__(self, mesh: Mesh | None = None):
+        self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"data": -1})
+
+    def batch_spec(self) -> P:
+        return P("data")
+
+
+class FSDP(Strategy):
+    """Twin of the FSDP recipe (main-fsdp.py:60-69): ZeRO-3-style sharding of
+    params, grads and optimizer state over the `data` axis, via GSPMD."""
+
+    name = "fsdp"
+
+    # Twin of size_based_auto_wrap_policy(min_num_params=100): tensors below
+    # the threshold stay replicated (main-fsdp.py:62).
+    def __init__(self, mesh: Mesh | None = None, min_shard_size: int = 100, cpu_offload: bool = False):
+        self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"data": -1})
+        self.min_shard_size = min_shard_size
+        self.cpu_offload = cpu_offload
+
+    def param_spec(self, shape: tuple[int, ...]) -> P:
+        axis_size = self.mesh.shape["data"]
+        size = 1
+        for d in shape:
+            size *= d
+        if size < self.min_shard_size:
+            return P()
+        # shard the largest dimension divisible by the axis size
+        candidates = [(d, i) for i, d in enumerate(shape) if d % axis_size == 0]
+        if not candidates:
+            return P()
+        _, dim = max(candidates)
+        spec = [None] * len(shape)
+        spec[dim] = "data"
+        return P(*spec)
+
+    def state_sharding(self, state_shapes):
+        shardings = _sharding_tree(self.mesh, self.param_spec, state_shapes)
+        if self.cpu_offload:
+            # Twin of CPUOffload(offload_params=True) (main-fsdp.py:68):
+            # sharded state lives in host memory; XLA streams it in on use.
+            # Host memory spaces are a TPU feature; on other backends the
+            # flag degrades to plain FSDP with a warning (the reference's
+            # CPUOffload is likewise CUDA-only).
+            if self._offload_supported():
+                shardings = jax.tree.map(
+                    lambda s: s.with_memory_kind("pinned_host"), shardings
+                )
+            else:
+                import warnings
+
+                warnings.warn(
+                    "--cpu_offload needs a TPU backend with host memory "
+                    "spaces; running plain FSDP instead",
+                    stacklevel=2,
+                )
+        return shardings
+
+    def _offload_supported(self) -> bool:
+        return jax.default_backend() in ("tpu", "axon")
+
+    def to_compute(self, state):
+        """Stream host-pinned state into device HBM at the top of the step
+        (the XLA twin of FSDP's CPUOffload H2D param streaming,
+        main-fsdp.py:68). The step's out_shardings put the updated state
+        back in host memory."""
+        if not (self.cpu_offload and self._offload_supported()):
+            return state
+
+        def put(leaf):
+            sharding = NamedSharding(
+                self.mesh, self.param_spec(leaf.shape), memory_kind="device"
+            )
+            return jax.device_put(leaf, sharding)
+
+        return jax.tree.map(put, state)
+
+    def batch_spec(self) -> P:
+        return P("data")

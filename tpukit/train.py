@@ -1,0 +1,285 @@
+"""Shared trainer: train state, jitted steps, and the epoch loop.
+
+The reference duplicates its whole train/eval/generate/checkpoint loop in
+every recipe (SURVEY §2.7; e.g. main-single.py:80-151 vs main-ddp.py:102-185
+are near-identical). Here the loop lives once and the *strategy* is the only
+thing a recipe supplies — the same pedagogical diff the cookbook wanted,
+without the duplication.
+
+Loop surface twins the reference exactly:
+  - running train loss printed through tqdm every PRINT_FREQ=8 steps
+    (main-single.py:19,104-108), process-0-gated in distributed recipes
+    (tqdm(..., disable=rank != 0), main-ddp.py:106,137);
+  - per-epoch validation loss + masked accuracy in the bar
+    (main-single.py:110-138);
+  - three fixed greedy generations per epoch: "The big brown cat ",
+    "One day, ", "She said " (main-single.py:140-144), process-0 only;
+  - end-of-training checkpoint (main-single.py:146-151).
+
+TPU-native differences (deliberate, documented):
+  - One jitted `train_step` holds forward+loss+backward+AdamW update; the
+    state is donated, so parameters update in place in HBM.
+  - The running-loss accumulator stays on device; the host syncs once per
+    PRINT_FREQ window instead of the reference's per-step `loss.item()`
+    (main-single.py:103, a D2H sync every step).
+  - bf16 is the compute dtype (no GradScaler twin: bf16 needs no loss
+    scaling; the reference's scaler is inert for bf16 anyway,
+    main-single.py:78). `--disable_amp` flips compute to fp32. Eval runs
+    in bf16 *unconditionally*, twinning the reference quirk of an
+    always-enabled eval autocast (main-single.py:119).
+  - `--disable_compile` maps to `jax.disable_jit()` (debug mode), the
+    analogue of skipping torch.compile (main-single.py:38-39).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from tqdm import tqdm
+
+from tpukit import checkpoint as ckpt_lib
+from tpukit.batching import prepare_batch
+from tpukit.data import get_dataset, get_tokenizer, transform_dataset
+from tpukit.flags import TrainFlags
+from tpukit.loader import DataLoader
+from tpukit.mesh import initialize_runtime, is_process_zero
+from tpukit.model import gpt
+from tpukit.profiling import MFUMeter, StepLogger, trace
+from tpukit.sampling import generate
+from tpukit.shardings import Strategy
+
+PRINT_FREQ = 8  # twin of main-single.py:19
+GENERATION_PROMPTS = ["The big brown cat ", "One day, ", "She said "]  # main-single.py:142-144
+
+
+class TrainState(struct.PyTreeNode):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def create_train_state(rng, cfg: gpt.GPTConfig, optimizer) -> TrainState:
+    params = gpt.init_params(rng, cfg)
+    return TrainState(params=params, opt_state=optimizer.init(params), step=jnp.int32(0))
+
+
+def make_optimizer(learning_rate: float) -> optax.GradientTransformation:
+    """Twin of `torch.optim.AdamW(params, lr=...)` (main-single.py:42): torch
+    AdamW defaults are betas=(0.9, 0.999), eps=1e-8, weight_decay=1e-2."""
+    return optax.adamw(learning_rate, b1=0.9, b2=0.999, eps=1e-8, weight_decay=1e-2)
+
+
+def make_step_fns(cfg: gpt.GPTConfig, optimizer, strategy: Strategy, state_shapes):
+    """Build jitted train/eval steps with the strategy's shardings applied.
+
+    GSPMD reads the in/out shardings and inserts the collectives: grad psum
+    for DP, per-tensor all-gather/reduce-scatter for FSDP, nothing for
+    single-device. The pipeline strategy's schedule is inside its loss_fn.
+    """
+    eval_cfg = cfg.replace(compute_dtype=jnp.bfloat16)  # eval autocast always on
+
+    def train_step(state: TrainState, batch, targets):
+        state = strategy.to_compute(state)
+
+        def loss_of(params):
+            loss, _ = strategy.loss_fn(params, cfg, batch, targets)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_of)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            loss,
+        )
+
+    def eval_step(state: TrainState, batch, targets):
+        state = strategy.to_compute(state)
+        loss, accuracy = strategy.loss_fn(
+            state.params, eval_cfg, batch, targets, with_accuracy=True
+        )
+        return loss, accuracy
+
+    state_sh = strategy.state_sharding(state_shapes)
+    state_sharding = TrainState(
+        params=state_sh.params, opt_state=state_sh.opt_state, step=strategy.replicated()
+    )
+    batch_sh = strategy.batch_sharding()
+    repl = strategy.replicated()
+
+    train_step = jax.jit(
+        train_step,
+        in_shardings=(state_sharding, batch_sh, batch_sh),
+        out_shardings=(state_sharding, repl),
+        donate_argnums=(0,),
+    )
+    eval_step = jax.jit(
+        eval_step,
+        in_shardings=(state_sharding, batch_sh, batch_sh),
+        out_shardings=(repl, repl),
+    )
+    return train_step, eval_step, state_sharding
+
+
+@dataclasses.dataclass
+class FitResult:
+    state: TrainState
+    tokenizer: Any
+    config: gpt.GPTConfig
+    checkpoint_path: Any
+    metrics: dict
+
+
+def fit(
+    flags: TrainFlags,
+    strategy: Strategy,
+    num_epochs: int | None = None,
+    make_loaders: Callable | None = None,
+) -> FitResult:
+    """The shared training entry point every recipe calls."""
+    initialize_runtime()
+    p0 = is_process_zero()
+
+    tokenizer = get_tokenizer()
+    tokenizer.pad_token_id = 2  # every recipe pins pad to 2 (main-single.py:23)
+
+    compute_dtype = jnp.float32 if flags.disable_amp else jnp.bfloat16
+    cfg = gpt.GPTConfig(
+        dim=flags.dim,
+        head_dim=flags.head_dim,
+        heads=flags.heads,
+        num_layers=flags.num_layers,
+        vocab_size=tokenizer.vocab_size,
+        max_position_embeddings=flags.sequence_length,
+        compute_dtype=compute_dtype,
+    )
+    optimizer = make_optimizer(flags.learning_rate)
+
+    # ---- data -----------------------------------------------------------
+    if make_loaders is not None:
+        train_loader, validation_loader = make_loaders(flags, tokenizer, strategy)
+    else:
+        train_ds, validation_ds = get_dataset(slice_size=flags.dataset_slice)
+        train_ds = transform_dataset(
+            train_ds, tokenizer, max_length=flags.sequence_length, num_proc=flags.num_workers
+        )
+        validation_ds = transform_dataset(
+            validation_ds, tokenizer, max_length=flags.sequence_length, num_proc=flags.num_workers
+        )
+        # Global batch = per-replica batch x data-parallel degree, the twin
+        # of "per-rank DataLoader(batch_size)" under torchrun (main-ddp.py:
+        # 83-100). Wrap-padding keeps every step full-shape, the twin of
+        # DistributedSampler's pad-by-wrapping.
+        replicas = strategy.mesh.shape.get("data", 1)
+        global_batch = flags.batch_size * replicas
+        train_loader = DataLoader(
+            train_ds, global_batch, shuffle=True, seed=flags.seed, drop_last=False,
+            pad_to_batch=replicas > 1,
+        )
+        validation_loader = DataLoader(
+            validation_ds, global_batch, shuffle=False, pad_to_batch=replicas > 1
+        )
+
+    # ---- state ----------------------------------------------------------
+    init_fn = partial(create_train_state, cfg=cfg, optimizer=optimizer)
+    state_shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(flags.seed))
+    train_step, eval_step, state_sharding = make_step_fns(cfg, optimizer, strategy, state_shapes)
+
+    # Initialize directly into the sharded layout (no host-side giant pytree).
+    state = jax.jit(init_fn, out_shardings=state_sharding)(jax.random.PRNGKey(flags.seed))
+
+    if flags.resume:
+        template = jax.device_get(state)
+        restored = ckpt_lib.restore(template, flags.resume)
+        state = jax.device_put(restored, state_sharding)
+        if p0:
+            print(f"resumed from {flags.resume} at step {int(state.step)}")
+
+    seq = flags.sequence_length - 1  # model sees S-1 after the shift
+    meter = MFUMeter(cfg, seq)
+    logger = StepLogger(flags.metrics_log if p0 else "")
+    epochs = num_epochs if num_epochs is not None else flags.epochs
+    checkpoint_path = None
+
+    import contextlib
+
+    maybe_nojit = jax.disable_jit() if flags.disable_compile else contextlib.nullcontext()
+    with maybe_nojit, trace(flags.profile_dir):
+        for epoch in range(epochs):
+            # ---- train ---------------------------------------------------
+            train_loader.set_epoch(epoch)
+            bar = tqdm(train_loader, disable=not p0)
+            bar.set_description(f"[training] Epoch {epoch+1}/{epochs} | loss: ?????")
+            running = None
+            for i, raw in enumerate(bar):
+                batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
+                state, loss = train_step(state, batch, targets)
+                running = loss if running is None else running + loss
+                meter.update(targets.size)
+                if i > 0 and not i % PRINT_FREQ:
+                    avg = float(running) / PRINT_FREQ  # one D2H sync per window
+                    bar.set_description(
+                        f"[training] Epoch {epoch+1}/{epochs} | loss: {avg:.3f}"
+                    )
+                    logger.log(
+                        kind="train", epoch=epoch, step=int(state.step), loss=avg,
+                        tokens_per_sec=meter.tokens_per_sec, mfu=meter.mfu,
+                    )
+                    running = None
+                if flags.checkpoint_every and int(state.step) % flags.checkpoint_every == 0:
+                    checkpoint_path = ckpt_lib.save(state) or checkpoint_path
+
+            # ---- validation ---------------------------------------------
+            bar = tqdm(validation_loader, disable=not p0)
+            bar.set_description(
+                f"[validation] Epoch {epoch+1}/{epochs} | loss: ?????, accuracy: ?????"
+            )
+            total_loss, total_acc = 0.0, 0.0
+            eval_metrics = {"loss": float("nan"), "accuracy": float("nan")}
+            for i, raw in enumerate(bar):
+                batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
+                loss, acc = eval_step(state, batch, targets)
+                total_loss += float(loss)
+                total_acc += float(acc)
+                eval_metrics = {"loss": total_loss / (i + 1), "accuracy": total_acc / (i + 1)}
+                bar.set_description(
+                    f"[validation] Epoch {epoch+1}/{epochs} | "
+                    f"loss: {eval_metrics['loss']:.3f}, accuracy: {eval_metrics['accuracy']:.2f}"
+                )
+            logger.log(kind="validation", epoch=epoch, **eval_metrics)
+
+            # ---- qualitative eval (process 0) ---------------------------
+            if p0:
+                print("Argmax sampling from model")
+                # offloaded state streams back to HBM for decoding
+                gen_params = strategy.to_compute(state).params
+                for prompt in GENERATION_PROMPTS:
+                    print(generate(gen_params, cfg, prompt, tokenizer))
+
+    # ---- final checkpoint (twin of main-single.py:146-151) --------------
+    checkpoint_path = ckpt_lib.save(state) or checkpoint_path
+    logger.close()
+
+    metrics = {
+        "eval": eval_metrics if epochs else {},
+        "tokens_per_sec": meter.tokens_per_sec,
+        "tokens_per_sec_per_chip": meter.tokens_per_sec_per_chip,
+        "mfu": meter.mfu,
+    }
+    if p0 and meter.tokens_per_sec:
+        print(
+            f"throughput: {meter.tokens_per_sec:,.0f} tok/s "
+            f"({meter.tokens_per_sec_per_chip:,.0f} tok/s/chip)"
+            + (f", MFU {meter.mfu*100:.1f}%" if meter.mfu else "")
+        )
+    return FitResult(
+        state=state, tokenizer=tokenizer, config=cfg,
+        checkpoint_path=checkpoint_path, metrics=metrics,
+    )

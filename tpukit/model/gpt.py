@@ -71,7 +71,9 @@ class GPTConfig:
     ffn_mult: int = 4  # reference FeedForward mult=4 (models/gpt.py:14)
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
-    attention_impl: str = "xla"  # "xla" | "flash" (Pallas)
+    # "auto" picks per shape: XLA's fused attention below 512 tokens, the
+    # Pallas flash kernel (tpukit/ops/pallas_attention.py) at 512 and above.
+    attention_impl: str = "auto"  # "auto" | "xla" | "flash"
 
     @property
     def inner_dim(self) -> int:

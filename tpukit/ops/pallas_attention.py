@@ -1,0 +1,366 @@
+"""Fused causal flash attention (Pallas TPU kernels, FlashAttention-2 style).
+
+Replaces the O(S^2)-memory attention of the reference (`models/gpt.py:79-99`
+materializes the full `[B, h, S, S]` score tensor; its own TODO at
+models/gpt.py:81-82 flags the cost). These kernels stream K/V blocks through
+VMEM with an online softmax, so no S x S tensor ever touches HBM — forward
+writes only the output and a log-sum-exp vector, and the backward kernels
+recompute scores blockwise.
+
+Masking semantics mirror tpukit/ops/attention.py (and therefore the
+reference) exactly: the causal constraint is a -1e9 additive term and the
+padding mask overwrites key columns with float32 finfo.min afterwards, so a
+fully-padded query row softmaxes uniformly rather than NaN-ing. One
+documented divergence: for a *fully padded* query row the XLA path attends
+uniformly over all S positions (the reference's masked_fill overwrites the
+causal term, models/gpt.py:90-95) while the kernel attends uniformly over
+j <= i; such rows carry ignore-index targets and never affect the loss.
+
+Layout: grid (batch*heads, q_blocks, k_blocks) with the k dimension
+innermost; running (m, l, acc) state lives in VMEM scratch across k steps
+(TPU grids execute sequentially). Causally-skipped blocks are gated with
+`pl.when` and their K/V fetches are clamped to the diagonal block so no
+wasted HBM traffic occurs. Per-row vectors ride in Mosaic-friendly 2-D
+layouts: the padding mask as a [B, 1, S_pad] row, log-sum-exp and the dO.O
+row sums as [BH, S_pad, 1] columns — every ref read/write stays rank>=2
+(rank-1 slices crash the Mosaic layout pass), and block shapes are
+(8, 128)-tile aligned or span their dimension.
+Sequence lengths are padded to the lane boundary in the wrapper; padded key
+columns are unreachable causally and padded query rows are sliced off.
+
+On non-TPU backends the same kernels run in Pallas interpreter mode, which
+keeps the unit tests (tests/test_flash_attention.py) exercising the exact
+kernel code path on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e9  # causal additive term (twin of models/gpt.py:83)
+
+_LANES = 128
+# Score-block edge. Bigger blocks amortize grid overhead at long sequence
+# lengths; sweepable via env for tuning.
+_BLOCK = max(_LANES, int(os.environ.get("TPUKIT_FLASH_BLOCK", "1024")))
+
+
+def on_tpu_backend() -> bool:
+    """Single source of truth for "is this a TPU-like backend" — shared with
+    the auto-dispatch in tpukit/ops/attention.py so the two cannot drift."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _interpret() -> bool:
+    return not on_tpu_backend()
+
+
+def _plan(seq: int) -> tuple[int, int]:
+    """(block, seq_pad) for a given sequence length. Mosaic requires the
+    score-block edge and the padded sequence to be lane-aligned: for
+    seq >= 128 both are 128-multiples (a 16-rounded block at e.g. S=520
+    fails lowering with a non-128-aligned pl.ds slice); shorter sequences
+    use a single 16-aligned block, which satisfies the sublane rule."""
+    if seq >= _LANES:
+        block = min(_BLOCK, -(-seq // _LANES) * _LANES)
+    else:
+        block = -(-seq // 16) * 16
+    seq_pad = -(-seq // block) * block
+    assert block % (16 if seq < _LANES else _LANES) == 0 and seq_pad % block == 0
+    return block, seq_pad
+
+
+def _masked_scores(q_blk, k_blk, mask_ref, scale, qi, ki, block_q, block_k):
+    """[BQ, BK] float32 scores with causal + padding masks applied, matching
+    the XLA path's order of operations. `mask_ref` is the [1, 1, S_pad] int32
+    padding-row ref; the ki-th block is sliced at the ref level as (1, BK)."""
+    s = jax.lax.dot_general(
+        q_blk,
+        k_blk,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    s = s + jnp.where(cols <= rows, 0.0, NEG_INF)
+    pad = mask_ref[0, :, pl.ds(ki * block_k, block_k)] == 1  # (1, BK)
+    return jnp.where(pad, jnp.finfo(jnp.float32).min, s), pad
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, block_q, block_k, num_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(ki <= qi)
+    def _():
+        q_blk = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        s, _ = _masked_scores(q_blk, k_blk, mask_ref, scale, qi, ki, block_q, block_k)
+
+        m_prev = m_scr[:, :1]  # (BQ, 1)
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        correction = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
+            p.astype(v_blk.dtype),
+            v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _():
+        l = l_scr[:, :1]  # (BQ, 1)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0, pl.ds(qi * block_q, block_q), :] = m_scr[:, :1] + jnp.log(l)
+
+
+def _flash_forward(q3, k3, v3, mask2, scale, heads):
+    """q3/k3/v3: [BH, S_pad, d]; mask2: [B, 1, S_pad] int32.
+    Returns (out [BH, S_pad, d], lse [BH, S_pad, 1])."""
+    bh, seq_pad, head_dim = q3.shape
+    block_q = block_k = min(_BLOCK, seq_pad) if seq_pad >= _LANES else seq_pad
+    num_q, num_k = seq_pad // block_q, seq_pad // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, num_k=num_k
+    )
+    # K/V fetches for causally-skipped blocks are clamped to the diagonal.
+    kv_index = lambda b, qi, ki: (b, jnp.minimum(qi, ki), 0)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, seq_pad), lambda b, qi, ki: (b // heads, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim), kv_index, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, seq_pad, 1), lambda b, qi, ki: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+            jax.ShapeDtypeStruct((bh, seq_pad, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(mask2, q3, k3, v3)
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref, dq_scr, *, scale, block_q, block_k, num_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(ki <= qi)
+    def _():
+        q_blk, k_blk, v_blk = q_ref[0], k_ref[0], v_ref[0]
+        do_blk = do_ref[0].astype(jnp.float32)
+        s, pad = _masked_scores(q_blk, k_blk, mask_ref, scale, qi, ki, block_q, block_k)
+        lse_col = lse_ref[0, pl.ds(qi * block_q, block_q), :]  # (BQ, 1)
+        dcap_col = dcap_ref[0, pl.ds(qi * block_q, block_q), :]
+        p = jnp.exp(s - lse_col)
+        dp = jax.lax.dot_general(
+            do_blk,
+            v_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dcap_col)
+        ds = jnp.where(pad, 0.0, ds)  # the where() in the fwd blocks grads
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds.astype(k_blk.dtype),
+            k_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == num_k - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block_q, block_k, num_q):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(qi >= ki)
+    def _():
+        q_blk, k_blk, v_blk = q_ref[0], k_ref[0], v_ref[0]
+        do_blk = do_ref[0].astype(jnp.float32)
+        s, pad = _masked_scores(q_blk, k_blk, mask_ref, scale, qi, ki, block_q, block_k)
+        lse_col = lse_ref[0, pl.ds(qi * block_q, block_q), :]  # (BQ, 1)
+        dcap_col = dcap_ref[0, pl.ds(qi * block_q, block_q), :]
+        p = jnp.exp(s - lse_col)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do_blk.dtype),
+            do_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk,
+            v_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dcap_col)
+        ds = jnp.where(pad, 0.0, ds)
+        dk_scr[:] += scale * jax.lax.dot_general(
+            ds.astype(q_blk.dtype),
+            q_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == num_q - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q3, k3, v3, mask2, out, lse, do3, scale, heads):
+    bh, seq_pad, head_dim = q3.shape
+    block_q = block_k = min(_BLOCK, seq_pad) if seq_pad >= _LANES else seq_pad
+    num_q, num_k = seq_pad // block_q, seq_pad // block_k
+
+    # D_i = rowsum(dO * O) — cheap, computed outside the kernels.
+    dcap = jnp.sum(do3.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
+
+    mask_spec = pl.BlockSpec((1, 1, seq_pad), lambda b, i, j: (b // heads, 0, 0), memory_space=pltpu.VMEM)
+    col_spec = pl.BlockSpec((1, seq_pad, 1), lambda b, i, j: (b, 0, 0), memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block_q=block_q, block_k=block_k, num_k=num_k),
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            mask_spec,
+            pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, qi, ki: (b, jnp.minimum(qi, ki), 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, qi, ki: (b, jnp.minimum(qi, ki), 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0), memory_space=pltpu.VMEM),
+            col_spec,
+            col_spec,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        interpret=_interpret(),
+    )(mask2, q3, k3, v3, do3, lse, dcap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k, num_q=num_q),
+        grid=(bh, num_k, num_q),
+        in_specs=[
+            mask_spec,
+            pl.BlockSpec((1, block_q, head_dim), lambda b, ki, qi: (b, jnp.maximum(qi, ki), 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, ki, qi: (b, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, ki, qi: (b, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, ki, qi: (b, jnp.maximum(qi, ki), 0), memory_space=pltpu.VMEM),
+            col_spec,
+            col_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, head_dim), lambda b, ki, qi: (b, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, ki, qi: (b, ki, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+            jax.ShapeDtypeStruct(v3.shape, v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(mask2, q3, k3, v3, do3, lse, dcap)
+
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q3, k3, v3, mask2, scale, heads):
+    out, _ = _flash_forward(q3, k3, v3, mask2, scale, heads)
+    return out
+
+
+def _flash_fwd(q3, k3, v3, mask2, scale, heads):
+    out, lse = _flash_forward(q3, k3, v3, mask2, scale, heads)
+    return out, (q3, k3, v3, mask2, out, lse)
+
+
+def _flash_bwd(scale, heads, residuals, g):
+    q3, k3, v3, mask2, out, lse = residuals
+    dq, dk, dv = _flash_backward(q3, k3, v3, mask2, out, lse, g, scale, heads)
+    dmask = np.zeros(mask2.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dmask
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_causal_attention(q, k, v, *, scale, pad_mask=None):
+    """Drop-in for the XLA path in tpukit/ops/attention.py.
+
+    q, k, v: [B, heads, S, head_dim]; pad_mask: optional [B, S] bool
+    (True = padding). Returns [B, heads, S, head_dim] in v's dtype.
+    """
+    batch, heads, seq, head_dim = q.shape
+    block, seq_pad = _plan(seq)
+
+    def prep(t):
+        t = t.reshape(batch * heads, seq, head_dim)
+        return jnp.pad(t, ((0, 0), (0, seq_pad - seq), (0, 0)))
+
+    q3, k3, v3 = prep(q), prep(k), prep(v)
+    if pad_mask is None:
+        mask2 = jnp.zeros((batch, 1, seq_pad), jnp.int32)
+    else:
+        mask2 = jnp.pad(pad_mask.astype(jnp.int32), ((0, 0), (0, seq_pad - seq)))[:, None, :]
+
+    out = _flash(q3, k3, v3, mask2, scale, heads)
+    return out[:, :seq].reshape(batch, heads, seq, head_dim)

@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Recipe 6 (tpukit extension): long-context training with ring attention.
+
+The reference cookbook has no long-context story — its attention
+materializes the full S x S score tensor on one device and sequence length
+caps at 256/512 (reference models/gpt.py:83-88, data.py:18; SURVEY §5).
+This recipe shards the *sequence* dimension over a `seq` mesh axis and
+computes exact causal attention with a `lax.ppermute` ring (K/V blocks
+rotate over ICI while each device keeps its query shard and online-softmax
+state) — see tpukit/ring_attention.py and the ContextParallel strategy.
+
+Use it when one chip can't hold the sequence:
+  python main-ring.py --sequence_length 8192 --batch_size 4 ...
+(sequence_length - 1 must divide by the number of sequence shards; on an
+8-device mesh the default grid is seq=8.)
+"""
+
+from tpukit.flags import parse_flags
+from tpukit.shardings import ContextParallel
+from tpukit.train import fit
+
+
+def main(argv=None):
+    flags = parse_flags(argv)
+    return fit(flags, ContextParallel())
+
+
+if __name__ == "__main__":
+    main()

@@ -73,7 +73,10 @@ class GPTConfig:
     param_dtype: Any = jnp.float32
     # "auto" picks per shape: XLA's fused attention below 512 tokens, the
     # Pallas flash kernel (tpukit/ops/pallas_attention.py) at 512 and above.
-    attention_impl: str = "auto"  # "auto" | "xla" | "flash"
+    # "ring" runs sequence-sharded ring attention (tpukit/ring_attention.py)
+    # over the `ring_axis` mesh axis — only valid inside shard_map.
+    attention_impl: str = "auto"  # "auto" | "xla" | "flash" | "ring"
+    ring_axis: str = "seq"
 
     @property
     def inner_dim(self) -> int:
@@ -188,6 +191,7 @@ def _apply_attention(layer, cfg: GPTConfig, x, pad_mask, rng, deterministic):
         scale=1.0 / (cfg.head_dim**0.5),
         pad_mask=pad_mask,
         impl=cfg.attention_impl,
+        ring_axis=cfg.ring_axis,
     )
     out = out.transpose(0, 2, 1, 3).reshape(batch, seq_len, cfg.inner_dim)
     out = linear(out, layer["attn"]["out"], cfg.compute_dtype)

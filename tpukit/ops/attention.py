@@ -36,6 +36,7 @@ def causal_attention(
     scale: float,
     pad_mask: jax.Array | None = None,
     impl: str = "xla",
+    ring_axis: str = "seq",
 ) -> jax.Array:
     """Scaled dot-product causal attention.
 
@@ -69,6 +70,12 @@ def causal_attention(
         from tpukit.ops.pallas_attention import flash_causal_attention
 
         return flash_causal_attention(q, k, v, scale=scale, pad_mask=pad_mask)
+    if impl == "ring":
+        from tpukit.ring_attention import ring_causal_attention
+
+        return ring_causal_attention(
+            q, k, v, scale=scale, axis_name=ring_axis, pad_mask=pad_mask
+        )
 
     seq_len = q.shape[2]
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale

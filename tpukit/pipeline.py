@@ -73,6 +73,14 @@ class Pipeline(Strategy):
 
     # -- shardings ---------------------------------------------------------
 
+    def validate_config(self, cfg: gpt.GPTConfig) -> None:
+        if cfg.num_layers % self.num_stages:
+            raise ValueError(
+                f"num_layers={cfg.num_layers} must divide evenly into "
+                f"{self.num_stages} pipeline stages; pad num_layers or "
+                f"choose a dividing stage count"
+            )
+
     def state_sharding(self, state_shapes):
         from jax.sharding import NamedSharding
 

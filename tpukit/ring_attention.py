@@ -1,0 +1,96 @@
+"""Ring attention: causal attention over a sequence-sharded mesh axis.
+
+The reference has no sequence/context parallelism at all — its attention is a
+dense single-device S x S matmul and the max sequence is 256 (SURVEY §2.4,
+§5; reference models/gpt.py:79-99, data.py:18). tpukit makes long-context a
+first-class axis: shard the *sequence* over a `seq` mesh axis and compute
+exact causal attention with the classic ring schedule — each device keeps
+its local Q block and online-softmax state while K/V (and the padding-mask
+slice that travels with them, the CP analogue of the reference pipeline's
+(x, mask) tuple threading) rotate around the ring via `lax.ppermute`, one
+hop per step, P steps total. Peak memory per device is O(S/P * S/P) scores
+and O(S/P) activations; the collective rides ICI.
+
+Masking matches tpukit/ops/attention.py: -1e9 additive causal term on
+*global* positions (each device knows its ring offset), then finfo.min
+overwrite for padded keys. As with the flash kernel, a fully-padded query
+row attends uniformly over its causal prefix rather than over all S (the
+XLA path's quirk); such rows are loss-ignored.
+
+Runs inside `shard_map` (Manual mesh axes) — see the ContextParallel
+strategy in tpukit/shardings.py. Autodiff through `ppermute`/`scan` gives
+the backward ring for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpukit.ops.attention import NEG_INF
+
+
+def ring_causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    scale: float,
+    axis_name: str,
+    pad_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Exact causal attention over sequence shards.
+
+    Args (all LOCAL shards, inside shard_map over `axis_name`):
+      q, k, v: `[B, heads, S_local, head_dim]`.
+      pad_mask: optional `[B, S_local]` bool, True = padding.
+
+    Returns `[B, heads, S_local, head_dim]` in v's dtype.
+    """
+    ring = jax.lax.axis_size(axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    batch, _, s_local, _ = q.shape
+    if pad_mask is None:
+        pad_mask = jnp.zeros((batch, s_local), dtype=jnp.bool_)
+
+    rows = my_index * s_local + jnp.arange(s_local)  # global query positions
+    qf = q.astype(jnp.float32)
+
+    # Each hop sends K/V/mask to the *next* device, so after i steps a device
+    # holds the block that originated at (my_index - i) mod ring.
+    perm = [(j, (j + 1) % ring) for j in range(ring)]
+
+    def step(carry, _):
+        m, l, acc, k_c, v_c, mask_c, src = carry
+
+        cols = src * s_local + jnp.arange(s_local)  # global key positions
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_c.astype(jnp.float32)) * scale
+        s = s + jnp.where(cols[None, :] <= rows[:, None], 0.0, NEG_INF)
+        s = jnp.where(
+            mask_c[:, None, None, :], jnp.finfo(jnp.float32).min, s
+        )
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        acc_new = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32)
+        )
+
+        k_next = jax.lax.ppermute(k_c, axis_name, perm)
+        v_next = jax.lax.ppermute(v_c, axis_name, perm)
+        mask_next = jax.lax.ppermute(mask_c, axis_name, perm)
+        return (m_new, l_new, acc_new, k_next, v_next, mask_next, (src - 1) % ring), None
+
+    init = (
+        jnp.full(q.shape[:3], -jnp.inf, jnp.float32),  # running max
+        jnp.zeros(q.shape[:3], jnp.float32),  # running denom
+        jnp.zeros(qf.shape, jnp.float32),  # running numerator
+        k,
+        v,
+        pad_mask,
+        my_index,
+    )
+    (m, l, acc, *_), _ = jax.lax.scan(step, init, None, length=ring)
+    return (acc / l[..., None]).astype(v.dtype)

@@ -18,6 +18,10 @@ reference got from NCCL:
     becomes a shard-size threshold. `cpu_offload=True` pins the sharded
     params/opt-state to host memory (twin of `CPUOffload(offload_params=
     True)`, main-fsdp.py:68).
+  - ContextParallel: the sequence dimension shards over a `seq` axis and
+    attention runs as a ppermute ring (tpukit/ring_attention.py) inside
+    shard_map — long-context capability the reference lacks entirely
+    (SURVEY §5: its attention materializes S x S on one device).
   - Pipeline strategies live in tpukit/pipeline.py (they need a schedule,
     not just shardings) and subclass `Strategy`.
 
@@ -70,6 +74,10 @@ class Strategy:
         device memory. Identity unless a strategy offloads (FSDP
         cpu_offload)."""
         return state
+
+    def validate_config(self, cfg: gpt.GPTConfig) -> None:
+        """Raise a clear error before any tracing when the model shape cannot
+        map onto this strategy's mesh (divisibility constraints)."""
 
     def replicated(self):
         return NamedSharding(self.mesh, P())
@@ -185,3 +193,154 @@ class FSDP(Strategy):
 
     def batch_spec(self) -> P:
         return P("data")
+
+
+class ContextParallel(Strategy):
+    """Sequence/context parallelism via ring attention.
+
+    The batch's *sequence* dimension shards over a `seq` mesh axis (optionally
+    combined with a `data` axis for batch sharding). The whole forward runs
+    inside shard_map: embeddings / norms / MLPs / head are token-local, and
+    attention is the exact-causal ppermute ring of tpukit/ring_attention.py.
+    Params are replicated; their gradient psum over the mesh falls out of the
+    shard_map transpose. This axis has no reference counterpart — the
+    cookbook caps sequence at 256 on one device (SURVEY §5) — and is the
+    scale-out path for the long-context capability.
+    """
+
+    name = "cp"
+
+    def __init__(self, mesh: Mesh | None = None):
+        self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"seq": -1})
+        if "seq" not in self.mesh.axis_names:
+            raise ValueError("ContextParallel needs a 'seq' mesh axis")
+        self.seq_size = self.mesh.shape["seq"]
+        self.data_size = self.mesh.shape.get("data", 1)
+
+    def batch_spec(self) -> P:
+        data = "data" if "data" in self.mesh.axis_names else None
+        return P(data, "seq")
+
+    def validate_config(self, cfg: gpt.GPTConfig) -> None:
+        # The model consumes sequence_length - 1 tokens after the LM shift
+        # (prepare_batch, tpukit/batching.py).
+        seq = cfg.max_position_embeddings - 1
+        if seq % self.seq_size:
+            raise ValueError(
+                f"--sequence_length {cfg.max_position_embeddings}: the model "
+                f"sequence {seq} must divide over {self.seq_size} sequence "
+                f"shards; pick sequence_length = k*{self.seq_size} + 1"
+            )
+
+    def loss_fn(self, params, cfg: gpt.GPTConfig, batch, targets, with_accuracy: bool = False):
+        seq_len = batch["input_ids"].shape[1]
+        if seq_len % self.seq_size:
+            raise ValueError(
+                f"sequence length {seq_len} must divide over {self.seq_size} "
+                f"sequence shards (pick a dividing --sequence_length)"
+            )
+        local_cfg = cfg.replace(attention_impl="ring", ring_axis="seq")
+        batch_spec = self.batch_spec()
+        axes = tuple(self.mesh.axis_names)
+
+        from jax import shard_map
+
+        def local_loss(params, input_ids, position_ids, mask, tgts):
+            x = gpt.apply_embeddings(params, local_cfg, input_ids, position_ids)
+            x = gpt.apply_decoder_layers(params["layers"], local_cfg, x, mask)
+            logits = gpt.apply_head(params, local_cfg, x).astype(jnp.float32)
+
+            valid = tgts != -100
+            safe = jnp.where(valid, tgts, 0)
+            logps = jax.nn.log_softmax(logits, axis=-1)
+            token_loss = -jnp.take_along_axis(logps, safe[..., None], axis=-1)[..., 0]
+            loss_sum = jnp.sum(jnp.where(valid, token_loss, 0.0))
+            count = jnp.sum(valid).astype(jnp.float32)
+            if with_accuracy:
+                correct = jnp.sum(
+                    jnp.where(valid, jnp.argmax(logits, axis=-1) == tgts, False)
+                ).astype(jnp.float32)
+            else:
+                correct = jnp.float32(0)
+            return (
+                jax.lax.psum(loss_sum, axes),
+                jax.lax.psum(count, axes),
+                jax.lax.psum(correct, axes),
+            )
+
+        loss_sum, count, correct = shard_map(
+            local_loss,
+            mesh=self.mesh,
+            in_specs=(P(), batch_spec, batch_spec, batch_spec, batch_spec),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )(params, batch["input_ids"], batch["position_ids"], batch["mask"], targets)
+
+        denom = jnp.maximum(count, 1.0)
+        return loss_sum / denom, correct / denom * 100.0
+
+
+class TensorParallel(Strategy):
+    """Megatron-style tensor parallelism, expressed purely as GSPMD shardings
+    (SURVEY §2.4 lists TP as absent from the reference; on TPU it is a
+    natural extension — no new code path, just different PartitionSpecs).
+
+    Per-layer rule over a `model` mesh axis (optionally x `data` for batch
+    sharding): q/k/v kernels and the ffn up-projection shard their *output*
+    (head / hidden) dimension — column parallel; the attention out-projection
+    and ffn down-projection shard their *input* dimension — row parallel, so
+    XLA inserts exactly one all-reduce after attention and one after the MLP,
+    the classic Megatron pattern. The lm_head shards its vocab dimension and
+    the token embedding its vocab rows. Dimensions that do not divide the
+    axis stay replicated. Optimizer state mirrors the parameter shardings.
+    """
+
+    name = "tp"
+
+    def __init__(self, mesh: Mesh | None = None):
+        self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"model": -1})
+        if "model" not in self.mesh.axis_names:
+            raise ValueError("TensorParallel needs a 'model' mesh axis")
+        self.model_size = self.mesh.shape["model"]
+
+    def batch_spec(self) -> P:
+        return P("data") if "data" in self.mesh.axis_names else P()
+
+    def _spec_for(self, names: tuple[str, ...], shape: tuple[int, ...]) -> P:
+        def shard(dim: int) -> P:
+            if shape[dim] % self.model_size:
+                return P()  # undividable -> replicate
+            spec = [None] * len(shape)
+            spec[dim] = "model"
+            return P(*spec)
+
+        path = "/".join(names)
+        if "attn" in names and names[-1] == "kernel":
+            if any(k in names for k in ("q", "k", "v")):
+                return shard(len(shape) - 1)  # column parallel
+            if "out" in names:
+                return shard(len(shape) - 2)  # row parallel
+        if "attn" in names and names[-1] == "bias" and any(
+            k in names for k in ("q", "k", "v")
+        ):
+            return shard(len(shape) - 1)
+        if "ffn" in names:
+            if "up" in names:
+                return shard(len(shape) - 1)  # column (kernel & bias)
+            if "down" in names and names[-1] == "kernel":
+                return shard(len(shape) - 2)  # row
+        if "lm_head" in names and names[-1] == "kernel":
+            return shard(len(shape) - 1)
+        if "token" in names:
+            return shard(0)  # vocab rows
+        del path
+        return P()
+
+    def state_sharding(self, state_shapes):
+        def spec(path, leaf):
+            names = tuple(
+                k.key for k in path if isinstance(k, jax.tree_util.DictKey)
+            )
+            return NamedSharding(self.mesh, self._spec_for(names, leaf.shape))
+
+        return jax.tree_util.tree_map_with_path(spec, state_shapes)

@@ -161,6 +161,7 @@ def fit(
         compute_dtype=compute_dtype,
     )
     optimizer = make_optimizer(flags.learning_rate)
+    strategy.validate_config(cfg)  # fail fast with a clear shape/mesh error
 
     # ---- data -----------------------------------------------------------
     if make_loaders is not None:

@@ -101,3 +101,84 @@ def test_dataloader_batching():
     e1 = np.concatenate([b["input_ids"] for b in loader])
     assert not np.array_equal(e0, e1)
     assert set(map(tuple, e0)) == set(map(tuple, e1))
+
+
+def test_dataloader_pad_to_batch_full_shapes():
+    """ADVICE r1: with pad_to_batch every batch has the full static shape, so
+    the jitted step never recompiles on a ragged final batch and Pipeline's
+    micro-batch divisor always holds."""
+    ds = ArrayDataset(
+        np.arange(40).reshape(10, 4).astype(np.int32),
+        np.ones((10, 4), dtype=np.int32),
+    )
+    loader = DataLoader(ds, batch_size=4, shuffle=False, pad_to_batch=True)
+    batches = list(loader)
+    assert len(batches) == 3
+    assert all(b["input_ids"].shape == (4, 4) for b in batches)
+    # padding wraps from the start of the index list
+    np.testing.assert_array_equal(
+        batches[2]["input_ids"][2:], ds.input_ids[:2]
+    )
+
+
+def test_dataloader_pad_smaller_than_batch():
+    """np.resize tiling: a dataset smaller than the pad still fills the batch."""
+    ds = ArrayDataset(
+        np.arange(24).reshape(6, 4).astype(np.int32),
+        np.ones((6, 4), dtype=np.int32),
+    )
+    loader = DataLoader(ds, batch_size=16, shuffle=False, pad_to_batch=True)
+    (batch,) = list(loader)
+    assert batch["input_ids"].shape == (16, 4)
+
+
+def test_dataloader_pad_mode_empty():
+    """Validation padding: all-ignore rows, not wrap-duplicates, so eval
+    metrics are not skewed by repeated samples."""
+    ds = ArrayDataset(
+        np.arange(40).reshape(10, 4).astype(np.int32),
+        np.ones((10, 4), dtype=np.int32),
+    )
+    loader = DataLoader(ds, batch_size=4, shuffle=False, pad_to_batch=True,
+                        pad_mode="empty", pad_fill=2)
+    batches = list(loader)
+    assert all(b["input_ids"].shape == (4, 4) for b in batches)
+    assert (batches[2]["input_ids"][2:] == 2).all()
+    assert (batches[2]["attention_mask"][2:] == 0).all()
+
+    from tpukit.batching import prepare_batch
+
+    _, targets = prepare_batch(batches[2], pad_id=2)
+    assert (targets[2:] == -100).all()
+
+
+def test_dataloader_pad_distributed_path():
+    """pad_to_batch applies after DistributedSampler-style sharding too."""
+    ds = ArrayDataset(
+        np.arange(40).reshape(10, 4).astype(np.int32),
+        np.ones((10, 4), dtype=np.int32),
+    )
+    loader = DataLoader(ds, batch_size=4, shuffle=False, pad_to_batch=True,
+                        num_replicas=2, rank=0)
+    batches = list(loader)  # 5 rows for rank 0 -> pad to 8
+    assert len(batches) == 2
+    assert all(b["input_ids"].shape == (4, 4) for b in batches)
+
+
+def test_dataloader_empty_pad_distributed_no_duplicates():
+    """pad_mode='empty' with num_replicas>1 must not wrap-duplicate samples:
+    the even-split padding uses all-ignore sentinel rows instead."""
+    ds = ArrayDataset(
+        np.arange(40).reshape(10, 4).astype(np.int32),
+        np.ones((10, 4), dtype=np.int32),
+    )
+    seen = []
+    for rank in range(4):
+        loader = DataLoader(ds, batch_size=4, shuffle=False, pad_to_batch=True,
+                            pad_mode="empty", pad_fill=2, num_replicas=4, rank=rank)
+        for b in loader:
+            assert b["input_ids"].shape == (4, 4)
+            real = b["attention_mask"].any(axis=1)
+            seen.extend(map(tuple, b["input_ids"][real]))
+    assert len(seen) == 10  # every sample exactly once, no duplicates
+    assert len(set(seen)) == 10

@@ -50,3 +50,20 @@ def test_generate_deterministic(tiny_config, tiny_params):
     b = generate(params, cfg, "She said ", tok, max_new_tokens=6)
     assert a == b
     assert a.startswith("She said ")
+
+
+def test_generate_prompt_capped_to_position_table(tiny_config, tiny_params):
+    """ADVICE r1: a prompt longer than the position table minus the decode
+    budget must be truncated, not silently clamp position lookups."""
+    from tpukit.data import WordTokenizer, synthetic_stories
+
+    tok = WordTokenizer(synthetic_stories(64))
+    long_prompt = " ".join(["the cat sat"] * 200)
+    # max_position_embeddings=64, max_new_tokens=20 -> prompt capped at 44
+    out = generate(tiny_params, tiny_config, long_prompt, tok, max_new_tokens=20)
+    assert isinstance(out, str)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="no room"):
+        generate(tiny_params, tiny_config, "hi", tok, max_new_tokens=64)

@@ -117,3 +117,63 @@ def test_latest_checkpoint(tmp_path):
     (tmp_path / "checkpoint-2026-01-01_00-00-00.msgpack").write_bytes(b"a")
     (tmp_path / "checkpoint-2026-01-02_00-00-00.msgpack").write_bytes(b"b")
     assert ckpt_lib.latest(tmp_path).name.startswith("checkpoint-2026-01-02")
+
+
+def test_batch_divisor_validation(tmp_path):
+    """A global batch that cannot split into the pipeline's micro-batches x
+    data shards must fail fast with a clear message, before any tracing."""
+    from tpukit.mesh import create_mesh
+    from tpukit.pipeline import Pipeline
+
+    strategy = Pipeline(create_mesh({"stage": 2}), num_microbatches=3)
+    with pytest.raises(ValueError, match="multiple of"):
+        fit(_tiny_flags(tmp_path, batch_size=16), strategy, num_epochs=0)
+
+
+def test_fit_pipeline_ragged_dataset(tmp_path):
+    """ADVICE r1 (medium): a dataset length not divisible by the batch size
+    under a pure stage mesh used to raise mid-epoch on the final partial
+    batch; pad_to_batch now wraps it to full shape."""
+    import os
+
+    from tpukit.mesh import create_mesh
+    from tpukit.pipeline import Pipeline
+
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        flags = _tiny_flags(tmp_path, batch_size=16, dataset_slice="40")
+        result = fit(flags, Pipeline(create_mesh({"stage": 2})))
+    finally:
+        os.chdir(cwd)
+    # 40 rows pad to 48 -> 3 full batches of 16
+    assert int(result.state.step) == 3
+    assert np.isfinite(result.metrics["eval"]["loss"])
+
+
+def test_debug_nans_flag(tmp_path):
+    """SURVEY §5 debug toolchain: --debug_nans flips jax_debug_nans inside
+    the training scope and restores it afterwards (no process-global leak)."""
+    import os
+
+    from tpukit.flags import parse_flags
+    from tpukit.train import _debug_nans_scope
+
+    assert parse_flags([]).debug_nans is False
+    assert parse_flags(["--debug_nans"]).debug_nans is True
+
+    assert not jax.config.jax_debug_nans
+    with _debug_nans_scope():
+        assert jax.config.jax_debug_nans
+        # NaNs inside jitted code now raise instead of propagating
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: jnp.log(x))(jnp.float32(-1.0)).block_until_ready()
+    assert not jax.config.jax_debug_nans
+
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        fit(_tiny_flags(tmp_path, debug_nans=True), SingleDevice(), num_epochs=0)
+        assert not jax.config.jax_debug_nans  # restored after fit
+    finally:
+        os.chdir(cwd)

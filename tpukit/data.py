@@ -196,6 +196,20 @@ def _fixture_corpus() -> tuple[list[str], list[str]]:
 # ---------------------------------------------------------------------------
 
 
+def _warn_fixture_fallback(kind: str, name: str, exc: Exception) -> None:
+    """Say loudly which corpus/tokenizer was actually selected: silently
+    training on synthetic data when the HF path fails would be a lie in the
+    reported metrics."""
+    import sys
+
+    print(
+        f"tpukit: hub {kind} '{name}' unavailable "
+        f"({type(exc).__name__}: {exc}); falling back to the offline "
+        f"synthetic fixture {kind}",
+        file=sys.stderr,
+    )
+
+
 def _parse_slice(n: int, slice_size: Optional[Union[str, int]]) -> int:
     """Twin of the `train[:{slice_size}]` split-string semantics at reference
     data.py:11: percent strings ("50%"), count strings ("1000"), or ints."""
@@ -226,7 +240,8 @@ def get_dataset(
         )
         validation = datasets.load_dataset(name, split="validation")
         return train, validation
-    except Exception:
+    except Exception as exc:
+        _warn_fixture_fallback("dataset", name, exc)
         train_texts, validation_texts = _fixture_corpus()
         n = _parse_slice(len(train_texts), slice_size)
         return ListDataset(train_texts[:n]), ListDataset(validation_texts)
@@ -240,9 +255,12 @@ def get_tokenizer(name: str = "roneneldan/TinyStories-1M", max_length: int = 512
         from transformers import GPT2Tokenizer  # type: ignore
 
         return GPT2Tokenizer.from_pretrained(
-            name, model_max_length=max_length, local_files_only=True
+            name,
+            model_max_length=max_length,
+            local_files_only=os.environ.get("TPUKIT_ALLOW_DOWNLOAD") != "1",
         )
-    except Exception:
+    except Exception as exc:
+        _warn_fixture_fallback("tokenizer", name, exc)
         train_texts, validation_texts = _fixture_corpus()
         return WordTokenizer(train_texts + validation_texts, model_max_length=max_length)
 
@@ -269,8 +287,7 @@ class ArrayDataset:
 def transform_dataset(dataset, tokenizer, max_length: int = 512, num_proc: int = 8) -> ArrayDataset:
     """Tokenize with max-length padding + truncation and drop the text column.
     Twin of reference data.py:23-36. `num_proc` is accepted for CLI parity;
-    host-side tokenization here is a single vectorized pass (the C++ loader
-    in tpukit/native is the high-throughput path)."""
+    host-side tokenization here is a single vectorized pass."""
     if hasattr(dataset, "map") and not isinstance(dataset, ListDataset):
         mapped = dataset.map(
             lambda ex: tokenizer(

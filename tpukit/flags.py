@@ -43,6 +43,9 @@ class TrainFlags:
     resume: str = ""  # checkpoint path to resume from (reference has no resume path)
     profile_dir: str = ""  # if set, jax.profiler traces land here
     metrics_log: str = ""  # if set, JSONL step metrics land here
+    # Debug toolchain (SURVEY §5 race-detection plan): aborts with a traceback
+    # at the first NaN/Inf produced inside any jitted computation.
+    debug_nans: bool = False
 
 
 # The canonical 12 flags of every reference recipe (main-single.py:156-167).
@@ -74,6 +77,7 @@ def build_parser(cpu_offload: bool = False) -> argparse.ArgumentParser:
     parser.add_argument("--resume", type=str, default=defaults.resume)
     parser.add_argument("--profile_dir", type=str, default=defaults.profile_dir)
     parser.add_argument("--metrics_log", type=str, default=defaults.metrics_log)
+    parser.add_argument("--debug_nans", action="store_true")
     return parser
 
 

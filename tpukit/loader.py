@@ -14,9 +14,7 @@ usage (main-single.py:62-75, main-ddp.py:83-100). Two pieces:
 
 `num_workers`/`pin_memory` have no TPU-native meaning for a numpy-backed
 in-memory dataset (there is no H2D pinning; transfers happen at the jit
-boundary); the flags are accepted for CLI parity. The optional native C++
-prefetching loader (tpukit/native) covers the reference's worker-process
-capability for disk-backed corpora.
+boundary); the flags are accepted for CLI parity.
 """
 
 from __future__ import annotations
@@ -81,6 +79,8 @@ class DataLoader:
         rank: int = 0,
         drop_last: bool = False,
         pad_to_batch: bool = False,
+        pad_mode: str = "wrap",
+        pad_fill: int = 0,
         num_workers: int = 0,  # parity only
         pin_memory: bool = False,  # parity only
     ):
@@ -91,34 +91,62 @@ class DataLoader:
         self.num_replicas = num_replicas
         self.rank = rank
         self.drop_last = drop_last
-        # pad_to_batch wraps indices so every batch is full-shape — the
-        # global-batch analogue of DistributedSampler's pad-by-wrapping
-        # (needed so a batch sharded over the `data` axis always divides).
+        # pad_to_batch keeps every batch full-shape (one static compiled step
+        # shape; a batch sharded over the `data` axis always divides).
+        # pad_mode "wrap" repeats rows from the front — the analogue of
+        # DistributedSampler's pad-by-wrapping, right for training.
+        # pad_mode "empty" appends rows of `pad_fill` tokens with a zero
+        # attention mask; prepare_batch turns those into all-ignore targets,
+        # so eval metrics are NOT skewed by duplicated samples.
+        if pad_mode not in ("wrap", "empty"):
+            raise ValueError(f"pad_mode must be 'wrap' or 'empty', got {pad_mode!r}")
         self.pad_to_batch = pad_to_batch
+        self.pad_mode = pad_mode
+        self.pad_fill = pad_fill
         self.epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
 
     def _indices(self) -> np.ndarray:
+        empty_pad = self.pad_to_batch and self.pad_mode == "empty"
         if self.num_replicas > 1:
-            return distributed_indices(
-                len(self.dataset),
-                self.num_replicas,
-                self.rank,
-                shuffle=self.shuffle,
-                seed=self.seed,
-                epoch=self.epoch,
-                drop_last=self.drop_last,
-            )
-        if self.shuffle:
+            if empty_pad and not self.drop_last:
+                # Same rank-stride math as distributed_indices, but the
+                # even-split padding uses -1 sentinels (-> all-ignore rows)
+                # instead of wrapped duplicates, keeping eval unskewed.
+                if self.shuffle:
+                    g = np.random.RandomState(self.seed + self.epoch)
+                    base = g.permutation(len(self.dataset))
+                else:
+                    base = np.arange(len(self.dataset))
+                total = math.ceil(len(base) / self.num_replicas) * self.num_replicas
+                base = np.concatenate(
+                    [base, np.full(total - len(base), -1, base.dtype)]
+                )
+                indices = base[self.rank : total : self.num_replicas]
+            else:
+                indices = distributed_indices(
+                    len(self.dataset),
+                    self.num_replicas,
+                    self.rank,
+                    shuffle=self.shuffle,
+                    seed=self.seed,
+                    epoch=self.epoch,
+                    drop_last=self.drop_last,
+                )
+        elif self.shuffle:
             g = np.random.RandomState(self.seed + self.epoch)
             indices = g.permutation(len(self.dataset))
         else:
             indices = np.arange(len(self.dataset))
         if self.pad_to_batch and len(indices) % self.batch_size:
             pad = self.batch_size - len(indices) % self.batch_size
-            indices = np.concatenate([indices, indices[:pad]])
+            if self.pad_mode == "wrap":
+                # np.resize tiles, so datasets smaller than the pad still fill
+                indices = np.concatenate([indices, np.resize(indices, pad)])
+            else:
+                indices = np.concatenate([indices, np.full(pad, -1, indices.dtype)])
         return indices
 
     def __len__(self) -> int:
@@ -133,7 +161,10 @@ class DataLoader:
         stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
         for start in range(0, stop, self.batch_size):
             idx = indices[start : start + self.batch_size]
-            yield {
-                "input_ids": self.dataset.input_ids[idx],
-                "attention_mask": self.dataset.attention_mask[idx],
-            }
+            ids = self.dataset.input_ids[np.maximum(idx, 0)]
+            mask = self.dataset.attention_mask[np.maximum(idx, 0)]
+            pad_rows = idx < 0  # -1 sentinels become all-ignore rows
+            if pad_rows.any():
+                ids = np.where(pad_rows[:, None], self.pad_fill, ids)
+                mask = np.where(pad_rows[:, None], 0, mask)
+            yield {"input_ids": ids, "attention_mask": mask}

@@ -73,6 +73,12 @@ class Pipeline(Strategy):
 
     # -- shardings ---------------------------------------------------------
 
+    @property
+    def batch_divisor(self) -> int:
+        # loss_fn splits the global batch into num_microbatches, each sharded
+        # over the data axis.
+        return self.num_microbatches * self.data_size
+
     def validate_config(self, cfg: gpt.GPTConfig) -> None:
         if cfg.num_layers % self.num_stages:
             raise ValueError(
@@ -110,7 +116,7 @@ class Pipeline(Strategy):
                 f"{num_stages} pipeline stages"
             )
         global_batch = batch["input_ids"].shape[0]
-        if global_batch % (num_micro * self.data_size):
+        if global_batch % self.batch_divisor:
             raise ValueError(
                 f"batch {global_batch} must divide into {num_micro} microbatches "
                 f"x {self.data_size} data shards"

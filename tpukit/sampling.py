@@ -64,7 +64,17 @@ def generate(
     max_new_tokens: int = 20,
 ) -> str:
     """Greedy-decode a continuation of `prompt`. See module docstring."""
-    encoded = tokenizer([prompt], truncation=True, max_length=256)
+    # The reference truncates prompts at a hard 256 (utils.py:57). Also cap
+    # at the position-embedding table so the whole buffer (prompt + new
+    # tokens) stays in-range — beyond it, position lookups would silently
+    # clamp to the last learned position instead of erroring.
+    max_prompt = min(256, cfg.max_position_embeddings - max_new_tokens)
+    if max_prompt < 1:
+        raise ValueError(
+            f"max_new_tokens={max_new_tokens} leaves no room for a prompt "
+            f"within max_position_embeddings={cfg.max_position_embeddings}"
+        )
+    encoded = tokenizer([prompt], truncation=True, max_length=max_prompt)
     ids = np.asarray(encoded["input_ids"][0], dtype=np.int32)
     prompt_len = int(ids.shape[0])
 

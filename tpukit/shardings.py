@@ -79,6 +79,14 @@ class Strategy:
         """Raise a clear error before any tracing when the model shape cannot
         map onto this strategy's mesh (divisibility constraints)."""
 
+    @property
+    def batch_divisor(self) -> int:
+        """Every global batch fed to this strategy must be a multiple of this.
+        The loader pads the final batch by wrapping to satisfy it (torch
+        `Pipe` handles uneven chunks internally; here the divisor is explicit
+        so every step keeps one static, compiled shape)."""
+        return self.mesh.shape.get("data", 1)
+
     def replicated(self):
         return NamedSharding(self.mesh, P())
 

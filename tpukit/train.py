@@ -128,6 +128,19 @@ def make_step_fns(cfg: gpt.GPTConfig, optimizer, strategy: Strategy, state_shape
     return train_step, eval_step, state_sharding
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _debug_nans_scope():
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
 @dataclasses.dataclass
 class FitResult:
     state: TrainState
@@ -176,16 +189,28 @@ def fit(
         )
         # Global batch = per-replica batch x data-parallel degree, the twin
         # of "per-rank DataLoader(batch_size)" under torchrun (main-ddp.py:
-        # 83-100). Wrap-padding keeps every step full-shape, the twin of
-        # DistributedSampler's pad-by-wrapping.
+        # 83-100). Wrap-padding keeps every step full-shape — the twin of
+        # DistributedSampler's pad-by-wrapping, applied unconditionally so
+        # the jitted step compiles exactly once (a ragged final batch would
+        # recompile and, under Pipeline, violate the micro-batch divisor).
         replicas = strategy.mesh.shape.get("data", 1)
         global_batch = flags.batch_size * replicas
+        if global_batch % strategy.batch_divisor:
+            raise ValueError(
+                f"global batch {global_batch} (batch_size {flags.batch_size} x "
+                f"{replicas} data shards) must be a multiple of "
+                f"{strategy.batch_divisor} for the {strategy.name} strategy"
+            )
         train_loader = DataLoader(
             train_ds, global_batch, shuffle=True, seed=flags.seed, drop_last=False,
-            pad_to_batch=replicas > 1,
+            pad_to_batch=True,
         )
+        # Validation pads with all-ignore rows (not wrap-duplicates), so the
+        # final batch's metrics equal the exact partial-batch metrics the
+        # reference's single-device eval computes (main-single.py:110-138).
         validation_loader = DataLoader(
-            validation_ds, global_batch, shuffle=False, pad_to_batch=replicas > 1
+            validation_ds, global_batch, shuffle=False, pad_to_batch=True,
+            pad_mode="empty", pad_fill=tokenizer.pad_token_id,
         )
 
     # ---- state ----------------------------------------------------------
@@ -209,10 +234,19 @@ def fit(
     epochs = num_epochs if num_epochs is not None else flags.epochs
     checkpoint_path = None
 
-    import contextlib
+    # The step counter is tracked on host (one D2H sync here, after a
+    # possible resume, then pure host arithmetic) so periodic checkpointing
+    # never forces a per-step `int(state.step)` sync inside the hot loop.
+    host_step = int(state.step)
 
     maybe_nojit = jax.disable_jit() if flags.disable_compile else contextlib.nullcontext()
-    with maybe_nojit, trace(flags.profile_dir):
+    # Debug toolchain (SURVEY §5): abort with a traceback at the first
+    # NaN/Inf inside any jitted computation. Scoped to this fit() so debug
+    # mode does not leak into later runs in the same process.
+    maybe_nans = (
+        _debug_nans_scope() if flags.debug_nans else contextlib.nullcontext()
+    )
+    with maybe_nojit, maybe_nans, trace(flags.profile_dir):
         for epoch in range(epochs):
             # ---- train ---------------------------------------------------
             train_loader.set_epoch(epoch)
@@ -222,6 +256,7 @@ def fit(
             for i, raw in enumerate(bar):
                 batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
                 state, loss = train_step(state, batch, targets)
+                host_step += 1
                 running = loss if running is None else running + loss
                 meter.update(targets.size)
                 if i > 0 and not i % PRINT_FREQ:
@@ -230,11 +265,11 @@ def fit(
                         f"[training] Epoch {epoch+1}/{epochs} | loss: {avg:.3f}"
                     )
                     logger.log(
-                        kind="train", epoch=epoch, step=int(state.step), loss=avg,
+                        kind="train", epoch=epoch, step=host_step, loss=avg,
                         tokens_per_sec=meter.tokens_per_sec, mfu=meter.mfu,
                     )
                     running = None
-                if flags.checkpoint_every and int(state.step) % flags.checkpoint_every == 0:
+                if flags.checkpoint_every and host_step % flags.checkpoint_every == 0:
                     checkpoint_path = ckpt_lib.save(state) or checkpoint_path
 
             # ---- validation ---------------------------------------------
@@ -261,8 +296,16 @@ def fit(
                 print("Argmax sampling from model")
                 # offloaded state streams back to HBM for decoding
                 gen_params = strategy.to_compute(state).params
+                # clamp the decode budget so tiny --sequence_length debug
+                # runs still fit a prompt in the position table
+                gen_tokens = min(20, cfg.max_position_embeddings - 2)
                 for prompt in GENERATION_PROMPTS:
-                    print(generate(gen_params, cfg, prompt, tokenizer))
+                    print(
+                        generate(
+                            gen_params, cfg, prompt, tokenizer,
+                            max_new_tokens=gen_tokens,
+                        )
+                    )
 
     # ---- final checkpoint (twin of main-single.py:146-151) --------------
     checkpoint_path = ckpt_lib.save(state) or checkpoint_path

@@ -18,8 +18,10 @@ def _random_batch(rng, cfg, batch=2, seq=16):
 def test_forward_shape_dtype(tiny_config, tiny_params, rng):
     ids, pos = _random_batch(rng, tiny_config)
     logits = forward(tiny_params, tiny_config, ids, pos)
-    assert logits.shape == (2, 16, tiny_config.vocab_size)
+    assert logits.shape == (2, 16, tiny_config.padded_vocab_size)
     assert logits.dtype == tiny_config.compute_dtype
+    # pad columns are forced to -1e9 so no consumer can select them
+    assert (np.asarray(logits)[..., tiny_config.vocab_size:] == -1e9).all()
 
 
 def test_causality(tiny_config, tiny_params, rng):
@@ -58,16 +60,16 @@ def test_double_activation_quirk(tiny_config, tiny_params, rng):
 def test_param_shapes_and_count(tiny_config, tiny_params):
     cfg = tiny_config
     p = tiny_params
-    assert p["embeddings"]["token"].shape == (cfg.vocab_size, cfg.dim)
+    assert p["embeddings"]["token"].shape == (cfg.padded_vocab_size, cfg.dim)
     assert p["embeddings"]["position"].shape == (cfg.max_position_embeddings, cfg.dim)
     assert p["layers"]["attn"]["q"]["kernel"].shape == (cfg.num_layers, cfg.dim, cfg.inner_dim)
     assert "bias" not in p["layers"]["attn"]["q"]  # qkv_bias=False (gpt.py:50)
     assert "bias" in p["layers"]["attn"]["out"]  # to_out has bias (gpt.py:64)
-    assert p["lm_head"]["kernel"].shape == (cfg.dim, cfg.vocab_size)
+    assert p["lm_head"]["kernel"].shape == (cfg.dim, cfg.padded_vocab_size)
     assert "bias" not in p["lm_head"]  # untied, bias=False (gpt.py:219)
 
     d, hd, h, L, v, pe, m = (
-        cfg.dim, cfg.head_dim, cfg.heads, cfg.num_layers, cfg.vocab_size,
+        cfg.dim, cfg.head_dim, cfg.heads, cfg.num_layers, cfg.padded_vocab_size,
         cfg.max_position_embeddings, cfg.ffn_mult,
     )
     inner = hd * h
@@ -102,14 +104,36 @@ def test_oo_veneer_matches_functional(tiny_config, tiny_params, rng):
 
 
 def test_scan_matches_unrolled(tiny_config, tiny_params, rng):
-    """The lax.scan trunk must equal an explicit python loop over layers."""
-    from tpukit.model.gpt import apply_decoder_layer, apply_embeddings, apply_head
-
+    """All three trunk execution modes (unrolled — the default, lax.scan,
+    and unrolled+remat) must produce identical logits."""
     ids, pos = _random_batch(rng, tiny_config, batch=1, seq=10)
-    x = apply_embeddings(tiny_params, tiny_config, ids, pos)
-    for i in range(tiny_config.num_layers):
-        layer = jax.tree.map(lambda p, i=i: p[i], tiny_params["layers"])
-        x = apply_decoder_layer(layer, tiny_config, x, None)
-    unrolled = apply_head(tiny_params, tiny_config, x)
-    scanned = forward(tiny_params, tiny_config, ids, pos)
+    unrolled = forward(tiny_params, tiny_config, ids, pos)
+    scanned = forward(
+        tiny_params, tiny_config.replace(scan_layers=True), ids, pos
+    )
+    remat = forward(
+        tiny_params, tiny_config.replace(remat_layers=True), ids, pos
+    )
     np.testing.assert_allclose(unrolled, scanned, atol=1e-5)
+    np.testing.assert_allclose(unrolled, remat, atol=1e-5)
+
+
+def test_remat_grads_match(tiny_config, tiny_params, rng):
+    """remat recomputes the forward in backward; grads must be unchanged."""
+    from tpukit.ops.layers import cross_entropy_loss
+
+    ids, pos = _random_batch(rng, tiny_config, batch=2, seq=12)
+    targets = jnp.asarray(
+        np.roll(np.asarray(ids), -1, axis=1).astype(np.int32)
+    )
+
+    def loss(p, cfg):
+        return cross_entropy_loss(forward(p, cfg, ids, pos), targets)
+
+    g_plain = jax.grad(loss)(tiny_params, tiny_config)
+    g_remat = jax.grad(loss)(tiny_params, tiny_config.replace(remat_layers=True))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5),
+        g_plain,
+        g_remat,
+    )

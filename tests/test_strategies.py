@@ -148,3 +148,46 @@ def test_dp_batch_sharding_spec():
     strategy = DataParallel(create_mesh({"data": 8}))
     assert strategy.batch_spec() == jax.sharding.PartitionSpec("data")
     assert strategy.param_spec((64, 64)) == jax.sharding.PartitionSpec()
+
+
+def test_fsdp_cpu_offload_degrades_on_cpu(cfg, batch):
+    """VERDICT r1 W3: --cpu_offload needs TPU host memory spaces; on the CPU
+    test backend it must warn and fall back to plain FSDP shardings (and the
+    train step must still run)."""
+    import warnings
+
+    model_batch, targets = batch
+    strategy = FSDP(create_mesh({"data": 8}), cpu_offload=True)
+    assert strategy.name == "fsdp-offload"
+    assert not strategy._offload_supported()
+
+    opt = make_optimizer(1e-3)
+    state = create_train_state(jax.random.PRNGKey(0), cfg, opt)
+    shapes = jax.eval_shape(lambda: state)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        sharding = strategy.state_sharding(shapes)
+    assert any("cpu_offload" in str(w.message) for w in caught)
+    # degraded shardings have no host memory kind
+    kinds = {s.memory_kind for s in jax.tree.leaves(sharding)}
+    assert "pinned_host" not in kinds
+
+    train_step, _, state_sharding = make_step_fns(cfg, opt, strategy, shapes)
+    state = jax.device_put(state, state_sharding)
+    new_state, loss = train_step(state, model_batch, targets)
+    assert np.isfinite(float(loss))
+
+
+def test_fsdp_offload_memory_kind_rule(cfg):
+    """On TPU-like backends the offload shardings pin params to host memory;
+    assert the rule by faking backend support (the real pinned_host path runs
+    in the TPU dryrun/bench)."""
+    strategy = FSDP(create_mesh({"data": 8}), cpu_offload=True)
+    strategy._offload_supported = lambda: True
+    opt = make_optimizer(1e-3)
+    shapes = jax.eval_shape(
+        lambda: create_train_state(jax.random.PRNGKey(0), cfg, opt)
+    )
+    sharding = strategy.state_sharding(shapes)
+    kinds = {s.memory_kind for s in jax.tree.leaves(sharding)}
+    assert kinds == {"pinned_host"}

@@ -8,7 +8,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from tpukit.mesh import create_mesh
-from tpukit.model import GPTConfig
+from tpukit.model import GPTConfig, init_params
 from tpukit.shardings import SingleDevice, TensorParallel
 from tpukit.train import create_train_state, make_optimizer, make_step_fns
 
@@ -112,6 +112,7 @@ def test_tp_undividable_dims_replicate():
     cfg = GPTConfig(
         dim=30, head_dim=6, heads=5, num_layers=1, vocab_size=151, ffn_mult=3,
         max_position_embeddings=16, compute_dtype=jnp.float32,
+        vocab_pad_multiple=1,  # keep vocab at 151 so no dim divides the axis
     )
     strategy = TensorParallel(create_mesh({"model": 8}))
     opt = make_optimizer(1e-3)
@@ -122,3 +123,37 @@ def test_tp_undividable_dims_replicate():
         jax.tree.map(lambda s: s.spec, sh.params)
     ):
         assert leaf == P() or leaf == P(None)
+
+
+def test_tp_loss_fn_disables_fused_qkv():
+    """TP must compute q/k/v as three column-parallel matmuls: concatenating
+    the column-sharded kernels would re-lay-out weights every step (verified
+    in review: the fused form emits dozens of all-to-alls in HLO)."""
+    captured = {}
+    import tpukit.model.gpt as gpt_mod
+
+    orig = gpt_mod._apply_attention
+
+    def spy(layer, cfg, *args, **kw):
+        captured["fuse_qkv"] = cfg.fuse_qkv
+        return orig(layer, cfg, *args, **kw)
+
+    strategy = TensorParallel(create_mesh({"model": 8}))
+    cfg = GPTConfig(
+        dim=32, head_dim=8, heads=4, num_layers=1, vocab_size=97,
+        max_position_embeddings=16, compute_dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ids = np.zeros((2, 8), np.int32)
+    batch = {
+        "input_ids": ids,
+        "position_ids": np.broadcast_to(np.arange(8, dtype=np.int32), ids.shape).copy(),
+        "mask": np.zeros_like(ids, dtype=bool),
+    }
+    targets = np.zeros_like(ids)
+    gpt_mod._apply_attention = spy
+    try:
+        strategy.loss_fn(params, cfg, batch, targets)
+    finally:
+        gpt_mod._apply_attention = orig
+    assert captured["fuse_qkv"] is False

@@ -46,6 +46,14 @@ class TrainFlags:
     # Debug toolchain (SURVEY §5 race-detection plan): aborts with a traceback
     # at the first NaN/Inf produced inside any jitted computation.
     debug_nans: bool = False
+    # Rematerialization policy: checkpoint each decoder layer (backward
+    # recomputes the layer forward; less HBM traffic and memory — needed for
+    # the larger ladder configs at long sequence).
+    remat: bool = False
+    # Run the layer stack as one lax.scan body instead of unrolled blocks
+    # (slower on v5e at the reference depth, but keeps compile time flat for
+    # very deep models).
+    scan_layers: bool = False
 
 
 # The canonical 12 flags of every reference recipe (main-single.py:156-167).
@@ -78,6 +86,8 @@ def build_parser(cpu_offload: bool = False) -> argparse.ArgumentParser:
     parser.add_argument("--profile_dir", type=str, default=defaults.profile_dir)
     parser.add_argument("--metrics_log", type=str, default=defaults.metrics_log)
     parser.add_argument("--debug_nans", action="store_true")
+    parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--scan_layers", action="store_true")
     return parser
 
 

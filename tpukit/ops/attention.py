@@ -54,18 +54,13 @@ def causal_attention(
         # from 512 up (+68% at S=1024, +130% at S=2048) and is the only
         # option at S >= 8k, where the materialized S x S no longer compiles.
         #
-        # Context gate: pallas_call composes with shard_map (Manual mesh
-        # axes — the pipeline recipes) and with single-device jit, but NOT
-        # with GSPMD-sharded operands under plain jit (pallas has no GSPMD
-        # partitioning rule), so DP/FSDP multi-chip traces fall back to XLA.
+        # The kernel is safe in every sharded context: custom_partitioning
+        # rules (tpukit/ops/pallas_attention.py) keep batch/head shardings
+        # under GSPMD jit (DP/FSDP/TP), and pallas_call composes directly
+        # with shard_map Manual regions (pipeline recipes).
         from tpukit.ops.pallas_attention import on_tpu_backend
 
-        ambient = jax.sharding.get_abstract_mesh()
-        manual = (not ambient.empty) and all(
-            str(t) == "Manual" for t in ambient.axis_types
-        )
-        safe_ctx = manual or jax.device_count() == 1
-        impl = "flash" if (on_tpu_backend() and safe_ctx and q.shape[2] >= 512) else "xla"
+        impl = "flash" if (on_tpu_backend() and q.shape[2] >= 512) else "xla"
     if impl == "flash":
         from tpukit.ops.pallas_attention import flash_causal_attention
 

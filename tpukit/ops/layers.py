@@ -51,21 +51,72 @@ def dropout(x: jax.Array, rate: float, rng: jax.Array | None, deterministic: boo
     return jnp.where(mask, x / keep, jnp.zeros_like(x))
 
 
+def _ce_terms(logits: jax.Array, targets: jax.Array):
+    valid = targets != IGNORE_INDEX
+    safe_targets = jnp.where(valid, targets, 0)
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    target_logit = jnp.take_along_axis(
+        logits, safe_targets[..., None], axis=-1
+    )[..., 0].astype(jnp.float32)
+    loss_sum = jnp.sum(jnp.where(valid, lse - target_logit, 0.0))
+    count = jnp.sum(valid).astype(jnp.float32)
+    return loss_sum, count, lse
+
+
+@jax.custom_vjp
+def cross_entropy_sum(logits: jax.Array, targets: jax.Array):
+    """(loss_sum, valid_count) of token cross-entropies with IGNORE_INDEX
+    masking, float32 accumulation.
+
+    Shared by every loss path (default strategies, the pipeline's per-stage
+    loss, ring-attention CP). Hand-written VJP for TPU memory behavior:
+
+      - forward is `logsumexp - target_logit`, so no `[B, S, V]` float32
+        log-softmax tensor materializes (the f32 cast fuses into the
+        reductions);
+      - backward is `(softmax - onehot) * g` where the onehot is an iota
+        comparison — pure elementwise, fused into the consuming matmuls.
+        Autodiff of the gather would instead scatter-add into a fresh f32
+        `[B, S, V]` buffer, which dominates the step (and OOMs the compile)
+        at the GPT-2 vocab for per-chip batches >= 256.
+    """
+    loss_sum, count, _ = _ce_terms(logits, targets)
+    return loss_sum, count
+
+
+def _ce_fwd(logits, targets):
+    loss_sum, count, lse = _ce_terms(logits, targets)
+    return (loss_sum, count), (logits, targets, lse)
+
+
+def _ce_bwd(residuals, g):
+    logits, targets, lse = residuals
+    g_sum = g[0]  # count depends only on (non-diff) targets
+    valid = targets != IGNORE_INDEX
+    probs = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    vocab = logits.shape[-1]
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (vocab,), 0)
+        == jnp.where(valid, targets, -1)[..., None]
+    )
+    scale = jnp.where(valid, g_sum, 0.0)[..., None]
+    dlogits = (probs - onehot.astype(jnp.float32)) * scale
+    return dlogits.astype(logits.dtype), None
+
+
+cross_entropy_sum.defvjp(_ce_fwd, _ce_bwd)
+
+
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """Mean token cross-entropy with IGNORE_INDEX masking.
 
     Twin of `F.cross_entropy(logits.view(-1, V), targets.view(-1),
     ignore_index=-100)` (reference main-single.py:95-96): the mean is taken
-    over non-ignored positions only. Computed in float32.
+    over non-ignored positions only. See cross_entropy_sum for the TPU
+    memory design.
     """
-    logits = logits.astype(jnp.float32)
-    valid = targets != IGNORE_INDEX
-    safe_targets = jnp.where(valid, targets, 0)
-    logps = jax.nn.log_softmax(logits, axis=-1)
-    token_loss = -jnp.take_along_axis(logps, safe_targets[..., None], axis=-1)[..., 0]
-    token_loss = jnp.where(valid, token_loss, 0.0)
-    denom = jnp.maximum(jnp.sum(valid), 1)
-    return jnp.sum(token_loss) / denom
+    loss_sum, count = cross_entropy_sum(logits, targets)
+    return loss_sum / jnp.maximum(count, 1.0)
 
 
 def masked_accuracy(logits: jax.Array, targets: jax.Array) -> jax.Array:

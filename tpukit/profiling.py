@@ -48,10 +48,12 @@ def peak_flops_per_chip(device_kind: str | None = None) -> float | None:
 
 
 def matmul_param_count(cfg: GPTConfig) -> int:
-    """Parameters that participate in matmuls (excludes embedding gathers)."""
+    """Parameters that participate in matmuls (excludes embedding gathers).
+    The lm_head runs at the padded vocab width — count the FLOPs actually
+    executed, not the logical vocab."""
     inner = cfg.inner_dim
     per_layer = 3 * cfg.dim * inner + inner * cfg.dim + 2 * cfg.dim * (cfg.dim * cfg.ffn_mult)
-    return cfg.num_layers * per_layer + cfg.dim * cfg.vocab_size
+    return cfg.num_layers * per_layer + cfg.dim * cfg.padded_vocab_size
 
 
 def train_flops_per_token(cfg: GPTConfig, seq_len: int) -> float:

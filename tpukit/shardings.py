@@ -37,7 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpukit import mesh as mesh_lib
 from tpukit.model import gpt
-from tpukit.ops.layers import cross_entropy_loss, masked_accuracy
+from tpukit.ops.layers import cross_entropy_loss, cross_entropy_sum, masked_accuracy
 
 
 def _sharding_tree(mesh: Mesh, spec_fn, tree_shapes):
@@ -141,6 +141,8 @@ class FSDP(Strategy):
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"data": -1})
         self.min_shard_size = min_shard_size
         self.cpu_offload = cpu_offload
+        if cpu_offload:
+            self.name = "fsdp-offload"
 
     def param_spec(self, shape: tuple[int, ...]) -> P:
         axis_size = self.mesh.shape["data"]
@@ -256,15 +258,12 @@ class ContextParallel(Strategy):
         def local_loss(params, input_ids, position_ids, mask, tgts):
             x = gpt.apply_embeddings(params, local_cfg, input_ids, position_ids)
             x = gpt.apply_decoder_layers(params["layers"], local_cfg, x, mask)
-            logits = gpt.apply_head(params, local_cfg, x).astype(jnp.float32)
-
-            valid = tgts != -100
-            safe = jnp.where(valid, tgts, 0)
-            logps = jax.nn.log_softmax(logits, axis=-1)
-            token_loss = -jnp.take_along_axis(logps, safe[..., None], axis=-1)[..., 0]
-            loss_sum = jnp.sum(jnp.where(valid, token_loss, 0.0))
-            count = jnp.sum(valid).astype(jnp.float32)
+            # custom-VJP sum: no f32 [B, S, V] tensor in either direction
+            # (tpukit/ops/layers.py cross_entropy_sum)
+            logits = gpt.apply_head(params, local_cfg, x)
+            loss_sum, count = cross_entropy_sum(logits, tgts)
             if with_accuracy:
+                valid = tgts != -100
                 correct = jnp.sum(
                     jnp.where(valid, jnp.argmax(logits, axis=-1) == tgts, False)
                 ).astype(jnp.float32)
@@ -313,6 +312,14 @@ class TensorParallel(Strategy):
 
     def batch_spec(self) -> P:
         return P("data") if "data" in self.mesh.axis_names else P()
+
+    def loss_fn(self, params, cfg: gpt.GPTConfig, batch, targets, with_accuracy: bool = False):
+        # The fused qkv matmul would concatenate kernels along their sharded
+        # (column) axis, forcing a weight re-layout every step — keep the
+        # three Megatron column-parallel matmuls instead.
+        return super().loss_fn(
+            params, cfg.replace(fuse_qkv=False), batch, targets, with_accuracy
+        )
 
     def _spec_for(self, names: tuple[str, ...], shape: tuple[int, ...]) -> P:
         def shard(dim: int) -> P:

@@ -33,6 +33,7 @@ TPU-native differences (deliberate, documented):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from functools import partial
 from typing import Any, Callable
@@ -128,9 +129,6 @@ def make_step_fns(cfg: gpt.GPTConfig, optimizer, strategy: Strategy, state_shape
     return train_step, eval_step, state_sharding
 
 
-import contextlib
-
-
 @contextlib.contextmanager
 def _debug_nans_scope():
     prev = jax.config.jax_debug_nans
@@ -172,6 +170,8 @@ def fit(
         vocab_size=tokenizer.vocab_size,
         max_position_embeddings=flags.sequence_length,
         compute_dtype=compute_dtype,
+        remat_layers=flags.remat,
+        scan_layers=flags.scan_layers,
     )
     optimizer = make_optimizer(flags.learning_rate)
     strategy.validate_config(cfg)  # fail fast with a clear shape/mesh error

@@ -37,7 +37,10 @@ def pick_grid(n_devices: int, num_layers: int) -> dict:
 def main(argv=None):
     flags = parse_flags(argv)
     grid = pick_grid(len(jax.devices()), flags.num_layers)
-    return fit(flags, Pipeline(create_mesh(grid)))
+    return fit(
+        flags,
+        Pipeline(create_mesh(grid), num_microbatches=flags.microbatches or "4x"),
+    )
 
 
 if __name__ == "__main__":

@@ -28,7 +28,9 @@ from tpukit.train import fit
 
 def main(argv=None):
     flags = parse_flags(argv)
-    return fit(flags, Pipeline())
+    # 4x micro-batches per stage shrink the GPipe bubble (divergence from
+    # the reference's chunks=num_stages; --microbatches N restores it)
+    return fit(flags, Pipeline(num_microbatches=flags.microbatches or "4x"))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,78 @@
+"""Recipe-level smoke tests (VERDICT r1 #6): every main-*.py CLI runs
+end-to-end on the 8-virtual-device CPU mesh — tiny model, one epoch on the
+offline fixture — and must produce a finite eval loss and a checkpoint.
+This exercises flag plumbing + strategy construction + fit() per recipe,
+the product surface the unit tests bypass."""
+
+import importlib.util
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+TINY_ARGS = [
+    "--batch_size", "8",
+    "--epochs", "1",
+    "--sequence_length", "33",
+    "--dim", "32",
+    "--head_dim", "8",
+    "--heads", "4",
+    "--num_layers", "4",
+    "--learning_rate", "1e-3",
+    "--dataset_slice", "64",
+    "--num_workers", "0",
+]
+
+
+def _run_recipe(name, tmp_path, extra=()):
+    spec = importlib.util.spec_from_file_location(name.replace("-", "_"), REPO / name)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    cwd = os.getcwd()
+    os.chdir(tmp_path)  # checkpoints/ lands in tmp
+    try:
+        result = mod.main(TINY_ARGS + list(extra))
+    finally:
+        os.chdir(cwd)
+    assert np.isfinite(result.metrics["eval"]["loss"])
+    assert result.checkpoint_path is not None and result.checkpoint_path.exists()
+    return result
+
+
+def test_recipe_single(tmp_path):
+    _run_recipe("main-single.py", tmp_path)
+
+
+def test_recipe_ddp(tmp_path):
+    _run_recipe("main-ddp.py", tmp_path)
+
+
+def test_recipe_fsdp(tmp_path):
+    _run_recipe("main-fsdp.py", tmp_path)
+
+
+def test_recipe_fsdp_cpu_offload(tmp_path):
+    # degrades to plain FSDP on the CPU backend, with a warning
+    with pytest.warns(UserWarning, match="cpu_offload"):
+        _run_recipe("main-fsdp.py", tmp_path, extra=["--cpu_offload"])
+
+
+def test_recipe_pipe(tmp_path):
+    # 8 virtual devices -> 8 stages: layers must divide; keep microbatches
+    # at the stage count so the tiny batch still divides
+    _run_recipe(
+        "main-pipe.py", tmp_path,
+        extra=["--num_layers", "8", "--microbatches", "8"],
+    )
+
+
+def test_recipe_pipe_ddp(tmp_path):
+    # grid picker -> (data=2, stage=4) on 8 devices
+    _run_recipe("main-pipe-ddp.py", tmp_path, extra=["--microbatches", "4"])
+
+
+def test_recipe_ring(tmp_path):
+    _run_recipe("main-ring.py", tmp_path)

@@ -67,3 +67,19 @@ def test_generate_prompt_capped_to_position_table(tiny_config, tiny_params):
 
     with pytest.raises(ValueError, match="no room"):
         generate(tiny_params, tiny_config, "hi", tok, max_new_tokens=64)
+
+
+def test_cached_decode_matches_naive(tiny_config, tiny_params):
+    """The KV-cached decode must produce the exact token sequence of the
+    naive full-re-forward loop, for several prompts."""
+    from tpukit.data import WordTokenizer, synthetic_stories
+
+    tok = WordTokenizer(synthetic_stories(64))
+    cfg = tiny_config.replace(vocab_size=tok.vocab_size, max_position_embeddings=64)
+    from tpukit.model import init_params
+
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    for prompt in ["One day, ", "The big brown cat ", "She said "]:
+        cached = generate(params, cfg, prompt, tok, max_new_tokens=12, use_cache=True)
+        naive = generate(params, cfg, prompt, tok, max_new_tokens=12, use_cache=False)
+        assert cached == naive

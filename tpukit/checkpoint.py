@@ -64,3 +64,152 @@ def latest(directory: str | os.PathLike = "checkpoints") -> Path | None:
         return None
     candidates = sorted(directory.glob("checkpoint-*.msgpack"))
     return candidates[-1] if candidates else None
+
+
+# ---------------------------------------------------------------------------
+# Sharded checkpoints (VERDICT r1 #7).
+#
+# The consolidated msgpack above gathers the whole state to one host —
+# fine for GPT-20M, impossible for the GPT-XL pipe x ddp ladder config on a
+# pod. The sharded format writes, per process, only the shards that process
+# addressably owns (deduplicated by replica_id), so no host ever
+# materializes the full state and hosts write in parallel:
+#
+#   <name>.sharded/
+#     manifest.json    # leaf paths, global shapes/dtypes (process 0)
+#     shard-<pid>.npz  # "<leaf-idx>|<start,start,...>" -> local block
+#
+# Restore rebuilds each leaf through `jax.make_array_from_callback` with the
+# *target* sharding, so a checkpoint written under one strategy restores
+# into any other strategy's shardings (FSDP -> TP, pipe -> single, ...).
+# ---------------------------------------------------------------------------
+
+
+def _leaf_paths(tree) -> list[str]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return ["/".join(str(k) for k in path) for path, _ in flat]
+
+
+def save_sharded(state, directory: str | os.PathLike = "checkpoints", name: str | None = None) -> Path:
+    """Write a sharded checkpoint. Every process participates; returns the
+    checkpoint directory. Atomic publish: everything is written into a
+    `.tmp` directory that process 0 renames only after all processes have
+    finished their shard files — a crash mid-save leaves no directory that
+    `latest_sharded`/`restore_sharded` would pick up."""
+    import json
+
+    import numpy as np
+
+    base = Path(directory) / ((name or _timestamp_name().replace(".msgpack", "")) + ".sharded")
+    tmp = base.with_name(base.name + ".tmp")
+    if is_process_zero():
+        tmp.mkdir(parents=True, exist_ok=True)
+    sync_global_devices("sharded_ckpt_mkdir")
+
+    leaves = [_as_jax_array(l) for l in jax.tree_util.tree_leaves(state)]
+    blocks = {}
+    for i, arr in enumerate(leaves):
+        for shard in arr.addressable_shards:
+            if shard.replica_id != 0:
+                continue  # exactly one process writes each block
+            starts = [s.start or 0 for s in shard.index] if shard.index else []
+            key = f"{i}|{','.join(map(str, starts))}"
+            blocks[key] = np.asarray(shard.data)
+    np.savez(tmp / f"shard-{jax.process_index():05d}.npz", **blocks)
+
+    if is_process_zero():
+        manifest = {
+            "nprocs": jax.process_count(),
+            "paths": _leaf_paths(state),
+            "leaves": [
+                {"shape": list(l.shape), "dtype": str(l.dtype)} for l in leaves
+            ],
+        }
+        (tmp / "manifest.json").write_text(json.dumps(manifest))
+    sync_global_devices("sharded_ckpt_written")
+    if is_process_zero():
+        tmp.rename(base)  # atomic publish
+    sync_global_devices("sharded_ckpt_published")
+    return base
+
+
+def _as_jax_array(x) -> jax.Array:
+    import jax.numpy as jnp
+
+    return x if isinstance(x, jax.Array) else jnp.asarray(x)
+
+
+def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
+    """Restore a sharded checkpoint into the structure of `template`,
+    placing each leaf with `sharding_tree` (defaults to the template
+    leaves' own shardings). The target shardings need not match the ones
+    the checkpoint was written under."""
+    import json
+
+    import numpy as np
+
+    base = Path(path)
+    manifest = json.loads((base / "manifest.json").read_text())
+    shard_files = sorted(base.glob("shard-*.npz"))
+    archives = [np.load(f) for f in shard_files]
+
+    flat, treedef = jax.tree_util.tree_flatten(template)
+    if sharding_tree is None:
+        shardings = [getattr(l, "sharding", None) for l in flat]
+    else:
+        shardings = jax.tree_util.tree_leaves(
+            sharding_tree, is_leaf=lambda x: isinstance(x, jax.sharding.Sharding)
+        )
+    if len(flat) != len(manifest["leaves"]):
+        raise ValueError(
+            f"template has {len(flat)} leaves, checkpoint has "
+            f"{len(manifest['leaves'])} ({base})"
+        )
+
+    restored = []
+    for i, (leaf, meta, sharding) in enumerate(zip(flat, manifest["leaves"], shardings)):
+        shape, dtype = tuple(meta["shape"]), np.dtype(meta["dtype"])
+        full = np.empty(shape, dtype)
+        covered = 0  # blocks are disjoint by construction (replica_id==0)
+        prefix = f"{i}|"
+        for ar in archives:
+            for key in ar.files:
+                if not key.startswith(prefix):
+                    continue
+                starts_s = key[len(prefix):]
+                block = ar[key]
+                if starts_s:
+                    starts = [int(s) for s in starts_s.split(",")]
+                    idx = tuple(
+                        slice(st, st + bs) for st, bs in zip(starts, block.shape)
+                    )
+                    full[idx] = block
+                else:
+                    full[()] = block
+                covered += int(block.size) if block.shape else 1
+        expected = int(np.prod(shape)) if shape else 1
+        if covered != expected:
+            raise ValueError(
+                f"checkpoint {base}: leaf {i} ({manifest['paths'][i]}) has "
+                f"{covered}/{expected} elements — a shard-*.npz file is "
+                f"missing (saved from {manifest['nprocs']} processes; are "
+                f"all shard files on this filesystem?)"
+            )
+        if sharding is not None:
+            restored.append(
+                jax.make_array_from_callback(shape, sharding, lambda idx, f=full: f[idx])
+            )
+        else:
+            restored.append(_as_jax_array(full))
+    return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+def latest_sharded(directory: str | os.PathLike = "checkpoints") -> Path | None:
+    directory = Path(directory)
+    if not directory.is_dir():
+        return None
+    candidates = sorted(
+        p for p in directory.glob("*.sharded")
+        if p.is_dir() and (p / "manifest.json").exists()
+    )
+    return candidates[-1] if candidates else None

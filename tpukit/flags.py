@@ -54,6 +54,10 @@ class TrainFlags:
     # (slower on v5e at the reference depth, but keeps compile time flat for
     # very deep models).
     scan_layers: bool = False
+    # Pipeline recipes: micro-batch count. 0 = 4x the stage count (shrinks
+    # the GPipe bubble to ~16%); the reference ties it to the stage count
+    # (chunks=num_stages, main-pipe.py:83) — pass it explicitly for that.
+    microbatches: int = 0
 
 
 # The canonical 12 flags of every reference recipe (main-single.py:156-167).
@@ -88,6 +92,7 @@ def build_parser(cpu_offload: bool = False) -> argparse.ArgumentParser:
     parser.add_argument("--debug_nans", action="store_true")
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--scan_layers", action="store_true")
+    parser.add_argument("--microbatches", type=int, default=defaults.microbatches)
     return parser
 
 

@@ -301,6 +301,70 @@ def apply_decoder_layers(
     return x
 
 
+# --------------------------------------------------------------------------
+# KV-cached decode path (no reference counterpart: the reference re-forwards
+# the whole growing sequence per generated token, utils.py:63-64 — a known
+# wart SURVEY §3.5 flags. Used by tpukit/sampling.py).
+# --------------------------------------------------------------------------
+
+
+def init_kv_cache(cfg: GPTConfig, batch: int, max_len: int) -> dict:
+    """Per-layer stacked K/V buffers: `[num_layers, B, heads, max_len, d]`."""
+    shape = (cfg.num_layers, batch, cfg.heads, max_len, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.compute_dtype),
+        "v": jnp.zeros(shape, cfg.compute_dtype),
+    }
+
+
+def _apply_attention_cached(layer, cfg: GPTConfig, x, k_cache, v_cache, start):
+    """Attention for decode: write this chunk's K/V into the cache at
+    `start` and attend over all cached positions `<= query position`.
+    x: [B, T, dim]; k_cache/v_cache: [B, heads, S_max, d]. Returns
+    (out, k_cache, v_cache)."""
+    batch, t = x.shape[0], x.shape[1]
+    q = linear(x, layer["attn"]["q"], cfg.compute_dtype)
+    k = linear(x, layer["attn"]["k"], cfg.compute_dtype)
+    v = linear(x, layer["attn"]["v"], cfg.compute_dtype)
+    split = lambda z: z.reshape(batch, t, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
+    q, k, v = split(q), split(k), split(v)
+
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, start, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, start, 0))
+
+    s_max = k_cache.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * (1.0 / cfg.head_dim**0.5)
+    key_pos = jnp.arange(s_max)[None, None, None, :]
+    q_pos = (start + jnp.arange(t))[None, None, :, None]
+    scores = jnp.where(key_pos <= q_pos, scores, jnp.asarray(-1e9, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache)
+    out = out.transpose(0, 2, 1, 3).reshape(batch, t, cfg.inner_dim)
+    out = linear(out, layer["attn"]["out"], cfg.compute_dtype)
+    return out, k_cache, v_cache
+
+
+def forward_cached(params: Params, cfg: GPTConfig, input_ids, position_ids, cache, start):
+    """Forward a chunk of tokens with the KV cache: writes K/V for positions
+    `[start, start+T)` and returns `(logits [B, T, padded_vocab], cache)`.
+    Prefill with the prompt chunk, then decode with T=1 per step."""
+    x = apply_embeddings(params, cfg, input_ids, position_ids)
+    new_k, new_v = [], []
+    for i in range(cfg.num_layers):
+        layer = jax.tree_util.tree_map(lambda t: t[i], params["layers"])
+        h = layer_norm(x, layer["norm1"]).astype(cfg.compute_dtype)
+        attn, k_c, v_c = _apply_attention_cached(
+            layer, cfg, h, cache["k"][i], cache["v"][i], start
+        )
+        new_k.append(k_c)
+        new_v.append(v_c)
+        x = x + attn
+        h = layer_norm(x, layer["norm2"]).astype(cfg.compute_dtype)
+        x = x + _apply_feed_forward(layer, cfg, h, None, True)
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    return apply_head(params, cfg, x), cache
+
+
 def apply_head(params: Params, cfg: GPTConfig, x) -> jax.Array:
     """Final LayerNorm + untied lm_head (models/gpt.py:217-219,229-231).
 

@@ -63,13 +63,31 @@ class Pipeline(Strategy):
 
     name = "pipe"
 
-    def __init__(self, mesh: Mesh | None = None, num_microbatches: int | None = None):
+    def __init__(
+        self, mesh: Mesh | None = None, num_microbatches: int | str | None = None
+    ):
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"stage": -1})
         if "stage" not in self.mesh.axis_names:
             raise ValueError("Pipeline strategy needs a 'stage' mesh axis")
         self.num_stages = self.mesh.shape["stage"]
-        # chunks = num_stages twin (main-pipe.py:83,93)
-        self.num_microbatches = num_microbatches or self.num_stages
+        # None -> chunks = num_stages, the reference twin (main-pipe.py:83,93).
+        # "4x"-style multipliers scale with the stage count: the GPipe bubble
+        # is (S-1)/(M+S-1), so M = 4S cuts it from ~43% to ~16% at S=4 —
+        # the recipes default to 4x (documented divergence; --microbatches
+        # restores any count including the reference's).
+        if isinstance(num_microbatches, str):
+            if not num_microbatches.endswith("x"):
+                raise ValueError(
+                    f"num_microbatches: int, None, or '<k>x', got {num_microbatches!r}"
+                )
+            self.num_microbatches = int(num_microbatches[:-1]) * self.num_stages
+        else:
+            self.num_microbatches = num_microbatches or self.num_stages
+        if self.num_microbatches < 1:
+            raise ValueError(
+                f"num_microbatches must be positive, got {self.num_microbatches} "
+                f"(from {num_microbatches!r})"
+            )
         self.data_size = self.mesh.shape.get("data", 1)
 
     # -- shardings ---------------------------------------------------------

@@ -129,6 +129,39 @@ def make_step_fns(cfg: gpt.GPTConfig, optimizer, strategy: Strategy, state_shape
     return train_step, eval_step, state_sharding
 
 
+def make_global_batch(batch_sharding, model_batch, targets):
+    """Assemble per-process host arrays into global device arrays.
+
+    Single-process: identity (jit places numpy at the sharding). Multi-host
+    (the v4-32 ladder configs: one process per host, SURVEY §2.5): each
+    process holds only its DistributedSampler shard of the batch —
+    `jax.make_array_from_process_local_data` builds the global sharded
+    array a cross-host jit can consume. This replaces the reference's
+    per-rank DataLoader+DistributedSampler feeding (main-ddp.py:83-100);
+    feeding the full global batch from every process would be rejected by
+    a jit whose shardings span non-addressable devices.
+    """
+    if jax.process_count() == 1:
+        return model_batch, targets
+
+    spec = batch_sharding.spec
+    if len(spec) > 0 and spec[0] is not None:
+        # batch rows are sharded across processes: each process supplied
+        # only its DistributedSampler shard
+        def conv(x):
+            return jax.make_array_from_process_local_data(batch_sharding, x)
+    else:
+        # rows are process-replicated (pure pipeline / CP seq sharding):
+        # every process loaded the identical full global batch; carve each
+        # host's addressable shards out of it
+        def conv(x):
+            return jax.make_array_from_callback(
+                x.shape, batch_sharding, lambda idx, x=x: x[idx]
+            )
+
+    return jax.tree.map(conv, model_batch), conv(targets)
+
+
 @contextlib.contextmanager
 def _debug_nans_scope():
     prev = jax.config.jax_debug_nans
@@ -201,16 +234,32 @@ def fit(
                 f"{replicas} data shards) must be a multiple of "
                 f"{strategy.batch_divisor} for the {strategy.name} strategy"
             )
+        # Multi-host: when the strategy shards batch rows, each process
+        # loads only its DistributedSampler shard of every global batch
+        # (twin of per-rank DataLoader under torchrun, main-ddp.py:83-100);
+        # make_global_batch assembles the global array. Strategies that
+        # replicate rows across processes (pure pipeline / CP) need the
+        # identical full batch on every host instead.
+        spec = strategy.batch_spec()
+        rows_sharded = len(spec) > 0 and spec[0] is not None
+        procs = jax.process_count() if rows_sharded else 1
+        rank = jax.process_index() if rows_sharded else 0
+        if global_batch % procs:
+            raise ValueError(
+                f"global batch {global_batch} must divide across {procs} hosts"
+            )
+        per_host = global_batch // procs
         train_loader = DataLoader(
-            train_ds, global_batch, shuffle=True, seed=flags.seed, drop_last=False,
-            pad_to_batch=True,
+            train_ds, per_host, shuffle=True, seed=flags.seed, drop_last=False,
+            pad_to_batch=True, num_replicas=procs, rank=rank,
         )
         # Validation pads with all-ignore rows (not wrap-duplicates), so the
         # final batch's metrics equal the exact partial-batch metrics the
         # reference's single-device eval computes (main-single.py:110-138).
         validation_loader = DataLoader(
-            validation_ds, global_batch, shuffle=False, pad_to_batch=True,
+            validation_ds, per_host, shuffle=False, pad_to_batch=True,
             pad_mode="empty", pad_fill=tokenizer.pad_token_id,
+            num_replicas=procs, rank=rank,
         )
 
     # ---- state ----------------------------------------------------------
@@ -228,6 +277,7 @@ def fit(
         if p0:
             print(f"resumed from {flags.resume} at step {int(state.step)}")
 
+    batch_sh = strategy.batch_sharding()
     seq = flags.sequence_length - 1  # model sees S-1 after the shift
     meter = MFUMeter(cfg, seq)
     logger = StepLogger(flags.metrics_log if p0 else "")
@@ -255,6 +305,7 @@ def fit(
             running = None
             for i, raw in enumerate(bar):
                 batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
+                batch, targets = make_global_batch(batch_sh, batch, targets)
                 state, loss = train_step(state, batch, targets)
                 host_step += 1
                 running = loss if running is None else running + loss
@@ -281,6 +332,7 @@ def fit(
             eval_metrics = {"loss": float("nan"), "accuracy": float("nan")}
             for i, raw in enumerate(bar):
                 batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
+                batch, targets = make_global_batch(batch_sh, batch, targets)
                 loss, acc = eval_step(state, batch, targets)
                 total_loss += float(loss)
                 total_acc += float(acc)

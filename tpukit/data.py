@@ -137,6 +137,25 @@ class WordTokenizer:
     def vocab_size(self) -> int:
         return len(self._id_to_token)
 
+    def _native_encoder(self):
+        """Multithreaded C++ batch encoder (tpukit/native) — the in-tree twin
+        of the reference's native fast-tokenizer + num_proc dependency path
+        (reference data.py:23-36). None when no compiler is available or
+        TPUKIT_NATIVE=0; output is byte-identical to the Python encoder
+        (tests/test_native.py)."""
+        if not hasattr(self, "_native"):
+            try:
+                from tpukit import native
+
+                self._native = (
+                    native.NativeEncoder(self._id_to_token, self.unk_token_id)
+                    if native.is_available()
+                    else None
+                )
+            except Exception:
+                self._native = None
+        return self._native
+
     def _encode_one(self, text: str) -> list[int]:
         ids = []
         for piece in _PIECE_RE.findall(text):
@@ -158,6 +177,13 @@ class WordTokenizer:
         if isinstance(texts, str):
             texts = [texts]
         max_length = max_length or self.model_max_length
+        if padding == "max_length" and truncation and len(texts) >= 64:
+            native = self._native_encoder()
+            if native is not None:
+                ids, mask = native.encode_batch(
+                    texts, max_length, self.pad_token_id
+                )
+                return {"input_ids": ids, "attention_mask": mask}
         encoded = [self._encode_one(t) for t in texts]
         if truncation:
             encoded = [ids[:max_length] for ids in encoded]

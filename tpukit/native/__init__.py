@@ -1,0 +1,120 @@
+"""tpukit native runtime components (C++ behind ctypes).
+
+The reference's host-side data path rides on native code inside its pip
+dependencies — HuggingFace fast tokenizers and `datasets.map(num_proc=8)`
+worker processes (reference data.py:23-36). tpukit's in-tree equivalent is
+this package: a multithreaded C++ batch tokenizer (tokenizer.cpp) exactly
+twinning `WordTokenizer`'s encoding, loaded through ctypes (pybind11 is
+deliberately not required).
+
+Build model: the shared library compiles lazily on first use with g++
+(cached next to the source, rebuilt when the .cpp is newer). Environments
+without a compiler simply fall back to the pure-Python encoder —
+`is_available()` gates every caller. Set TPUKIT_NATIVE=0 to force the
+Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_DIR = Path(__file__).resolve().parent
+_SRC = _DIR / "tokenizer.cpp"
+_LIB = _DIR / "libtpukit_native.so"
+
+_lib = None
+_build_error: str | None = None
+
+
+def _build() -> None:
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        str(_SRC), "-o", str(_LIB),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _load():
+    global _lib, _build_error
+    if _lib is not None or _build_error is not None:
+        return _lib
+    if os.environ.get("TPUKIT_NATIVE") == "0":
+        _build_error = "disabled via TPUKIT_NATIVE=0"
+        return None
+    try:
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            _build()
+        lib = ctypes.CDLL(str(_LIB))
+        lib.tpukit_tok_create.restype = ctypes.c_void_p
+        lib.tpukit_tok_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.tpukit_tok_destroy.argtypes = [ctypes.c_void_p]
+        lib.tpukit_tok_encode_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int32,
+        ]
+        _lib = lib
+    except Exception as exc:  # no compiler / bad toolchain -> Python path
+        _build_error = f"{type(exc).__name__}: {exc}"
+    return _lib
+
+
+def is_available() -> bool:
+    return _load() is not None
+
+
+class NativeEncoder:
+    """Batch encoder over a WordTokenizer-compatible vocab. Produces output
+    byte-identical to `WordTokenizer.__call__(padding='max_length',
+    truncation=True)` (asserted by tests/test_native.py)."""
+
+    def __init__(self, id_to_token: list[str], unk_id: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native tokenizer unavailable: {_build_error}")
+        self._lib = lib
+        blob = b"\0".join(t.encode("utf-8") for t in id_to_token) + b"\0"
+        self._handle = lib.tpukit_tok_create(
+            blob, len(blob), len(id_to_token), unk_id
+        )
+        if not self._handle:
+            raise RuntimeError("tpukit_tok_create failed")
+
+    def encode_batch(
+        self,
+        texts: list[str],
+        max_length: int,
+        pad_id: int,
+        n_threads: int | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (input_ids, attention_mask), both [N, max_length] int32."""
+        encoded = [t.encode("utf-8") for t in texts]
+        offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        blob = b"".join(encoded)
+        n = len(encoded)
+        ids = np.empty((n, max_length), dtype=np.int32)
+        mask = np.empty((n, max_length), dtype=np.int32)
+        if n_threads is None:
+            n_threads = min(os.cpu_count() or 1, 16)
+        self._lib.tpukit_tok_encode_batch(
+            self._handle, blob, offsets, n, max_length, pad_id, ids, mask,
+            n_threads,
+        )
+        return ids, mask
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle and self._lib is not None:
+            self._lib.tpukit_tok_destroy(handle)

@@ -76,3 +76,20 @@ def test_recipe_pipe_ddp(tmp_path):
 
 def test_recipe_ring(tmp_path):
     _run_recipe("main-ring.py", tmp_path)
+
+
+def test_recipe_fsdp_sharded_checkpoint_and_resume(tmp_path):
+    """VERDICT r2 #1 done-criterion: a sharded recipe with --checkpoint_every
+    writes a step-keyed .sharded dir and --resume latest restores from it."""
+    result = _run_recipe(
+        "main-fsdp.py", tmp_path,
+        extra=["--checkpoint_every", "4", "--checkpoint_format", "sharded"],
+    )
+    assert result.checkpoint_path.name.endswith(".sharded")
+    assert result.checkpoint_path.is_dir()
+    assert (result.checkpoint_path / "manifest.json").exists()
+    resumed = _run_recipe(
+        "main-fsdp.py", tmp_path,
+        extra=["--checkpoint_format", "sharded", "--resume", "latest"],
+    )
+    assert int(resumed.state.step) == 2 * int(result.state.step)

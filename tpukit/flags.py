@@ -40,7 +40,11 @@ class TrainFlags:
     # tpukit extensions (absent in the reference; see SURVEY §5 plans):
     seed: int = 0
     checkpoint_every: int = 0  # steps; 0 = end-of-training only (reference behavior)
-    resume: str = ""  # checkpoint path to resume from (reference has no resume path)
+    # "auto" writes the sharded format exactly when the state cannot be
+    # host-gathered (multi-host FSDP/pipeline), else the consolidated
+    # msgpack the reference-style save produces. Force either explicitly.
+    checkpoint_format: str = "auto"  # auto | consolidated | sharded
+    resume: str = ""  # checkpoint path (either format) or "latest"
     profile_dir: str = ""  # if set, jax.profiler traces land here
     metrics_log: str = ""  # if set, JSONL step metrics land here
     # Debug toolchain (SURVEY §5 race-detection plan): aborts with a traceback
@@ -86,6 +90,11 @@ def build_parser(cpu_offload: bool = False) -> argparse.ArgumentParser:
         parser.add_argument("--cpu_offload", action="store_true")
     parser.add_argument("--seed", type=int, default=defaults.seed)
     parser.add_argument("--checkpoint_every", type=int, default=defaults.checkpoint_every)
+    parser.add_argument(
+        "--checkpoint_format",
+        choices=("auto", "consolidated", "sharded"),
+        default=defaults.checkpoint_format,
+    )
     parser.add_argument("--resume", type=str, default=defaults.resume)
     parser.add_argument("--profile_dir", type=str, default=defaults.profile_dir)
     parser.add_argument("--metrics_log", type=str, default=defaults.metrics_log)

@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Recipe 6: tensor-parallel training (beyond-reference; SURVEY §2.4 stretch).
+
+The reference has no tensor-parallel recipe — its parallelism ladder stops
+at pipeline (SURVEY §2.4). On TPU, Megatron-style TP is pure shardings: q/k/v
+and the ffn up-projection shard their output dimension (column parallel), the
+attention out-projection and ffn down-projection shard their input dimension
+(row parallel), so XLA inserts exactly one all-reduce after attention and one
+after the MLP — see tpukit.shardings.TensorParallel. The lm_head and token
+embedding shard their vocab dimension.
+
+The device grid follows the classic layout: `model` (TP) innermost so its
+per-layer all-reduces ride the fastest ICI links, the remaining devices
+data-parallel, e.g. 8 devices -> (data=2, model=4).
+
+Run: `python main-tp.py --batch_size 64 ...` (batch_size is per data shard,
+as in the per-rank reference loader).
+"""
+
+import jax
+
+from tpukit.flags import parse_flags
+from tpukit.mesh import create_mesh
+from tpukit.shardings import TensorParallel
+from tpukit.train import fit
+
+
+def pick_grid(n_devices: int, heads: int) -> dict:
+    """Largest model-parallel degree <= 4 that divides the device count and
+    the head count (column-parallel q/k/v shard the head dimension);
+    remaining devices become data-parallel replicas."""
+    for model in (4, 2, 1):
+        if n_devices % model == 0 and heads % model == 0:
+            return {"data": n_devices // model, "model": model}
+    return {"data": n_devices, "model": 1}
+
+
+def main(argv=None):
+    flags = parse_flags(argv)
+    grid = pick_grid(len(jax.devices()), flags.heads)
+    return fit(flags, TensorParallel(create_mesh(grid)))
+
+
+if __name__ == "__main__":
+    main()

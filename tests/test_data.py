@@ -182,3 +182,31 @@ def test_dataloader_empty_pad_distributed_no_duplicates():
             seen.extend(map(tuple, b["input_ids"][real]))
     assert len(seen) == 10  # every sample exactly once, no duplicates
     assert len(set(seen)) == 10
+
+
+def test_loader_real_rows_ragged():
+    """Honest token accounting (VERDICT r2 #8): wrap-padded rows are flagged,
+    so an epoch's real_rows sum equals the dataset size, not the padded one."""
+    ds = ArrayDataset(
+        np.arange(40).reshape(10, 4).astype(np.int32),
+        np.ones((10, 4), dtype=np.int32),
+    )
+    loader = DataLoader(ds, batch_size=4, shuffle=True, pad_to_batch=True)
+    batches = list(loader)
+    assert all(b["input_ids"].shape == (4, 4) for b in batches)  # still full
+    assert sum(b["real_rows"] for b in batches) == 10  # not 12
+
+
+def test_loader_real_rows_distributed():
+    """Across ranks, wrap-duplicates from the even-split padding are not
+    counted: the global real_rows sum is the dataset size."""
+    ds = ArrayDataset(
+        np.arange(40).reshape(10, 4).astype(np.int32),
+        np.ones((10, 4), dtype=np.int32),
+    )
+    total = 0
+    for rank in range(4):
+        loader = DataLoader(ds, batch_size=3, shuffle=True, pad_to_batch=True,
+                            num_replicas=4, rank=rank)
+        total += sum(b["real_rows"] for b in loader)
+    assert total == 10

@@ -1,0 +1,103 @@
+"""Dropout is real in the train path (VERDICT r2 #6): a step rng threads
+through every strategy's loss, changes the loss when dropout > 0, and never
+touches the eval path."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpukit.mesh import create_mesh
+from tpukit.model import GPTConfig, gpt
+from tpukit.pipeline import Pipeline
+from tpukit.shardings import ContextParallel, SingleDevice, TensorParallel
+
+
+def _cfg(dropout, **kw):
+    base = dict(
+        dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=97,
+        max_position_embeddings=33, compute_dtype=jnp.float32, dropout=dropout,
+    )
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def _batch(cfg, batch=8, seq=32, seed=0):
+    r = np.random.RandomState(seed)
+    ids = r.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    batch_d = {
+        "input_ids": jnp.asarray(ids),
+        "position_ids": jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32), (batch, seq)),
+        "mask": jnp.zeros((batch, seq), bool),
+    }
+    targets = jnp.asarray(r.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    return batch_d, targets
+
+
+STRATEGIES = [
+    ("single", lambda: SingleDevice(), {}),
+    ("pipe", lambda: Pipeline(create_mesh({"stage": 2}), num_microbatches=2), {}),
+    ("cp", lambda: ContextParallel(create_mesh({"seq": 2})), {}),
+    ("tp", lambda: TensorParallel(create_mesh({"model": 2})), {}),
+]
+
+
+@pytest.mark.parametrize("name,make,kw", STRATEGIES, ids=[s[0] for s in STRATEGIES])
+def test_dropout_changes_train_loss(name, make, kw):
+    strategy = make()
+    cfg = _cfg(0.5)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    batch, targets = _batch(cfg)
+    rng = jax.random.PRNGKey(7)
+
+    base, _ = jax.jit(lambda p: strategy.loss_fn(p, cfg, batch, targets))(params)
+    dropped, _ = jax.jit(lambda p, r: strategy.loss_fn(p, cfg, batch, targets, rng=r))(
+        params, rng
+    )
+    # No rng -> deterministic: dropout is inert even at rate 0.5 (eval path).
+    no_drop_cfg = _cfg(0.0)
+    base0, _ = jax.jit(lambda p: strategy.loss_fn(p, no_drop_cfg, batch, targets))(params)
+    np.testing.assert_allclose(float(base), float(base0), rtol=1e-6)
+    # With rng the loss must move.
+    assert abs(float(dropped) - float(base)) > 1e-4
+
+    # Different step keys -> different masks -> different losses.
+    dropped2, _ = jax.jit(lambda p, r: strategy.loss_fn(p, cfg, batch, targets, rng=r))(
+        params, jax.random.PRNGKey(8)
+    )
+    assert abs(float(dropped2) - float(dropped)) > 1e-6
+
+
+def test_train_step_threads_step_rng():
+    """make_step_fns folds state.step into the key: consecutive steps from
+    the same state produce different dropout masks, and eval is untouched."""
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    cfg = _cfg(0.5)
+    strategy = SingleDevice()
+    optimizer = make_optimizer(1e-3)
+    state_shapes = jax.eval_shape(
+        lambda r: create_train_state(r, cfg, optimizer), jax.random.PRNGKey(0)
+    )
+    train_step, eval_step, sharding = make_step_fns(
+        cfg, optimizer, strategy, state_shapes, seed=0
+    )
+    state = jax.jit(
+        lambda r: create_train_state(r, cfg, optimizer), out_shardings=sharding
+    )(jax.random.PRNGKey(0))
+    batch, targets = _batch(cfg)
+
+    state1, loss1 = train_step(state, batch, targets)
+    # same params would give the same loss without dropout; with step-keyed
+    # dropout the second step (step=1) sees a different mask. Compare the
+    # second step's loss against re-running step 0's computation on the
+    # updated params WITHOUT dropout.
+    eval_loss, _ = eval_step(state1, batch, targets)
+    # eval twice is bit-identical (no rng anywhere in the eval path)
+    eval_loss2, _ = eval_step(state1, batch, targets)
+    assert float(eval_loss) == float(eval_loss2)
+    # dropout active in train: the step's loss differs from the same params'
+    # deterministic loss (same cfg/dtype, no rng)
+    plain, _ = jax.jit(lambda p: strategy.loss_fn(p, cfg, batch, targets))(state1.params)
+    _, loss2 = train_step(state1, batch, targets)  # donates state1
+    assert abs(float(loss2) - float(plain)) > 1e-4

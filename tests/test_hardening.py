@@ -1,0 +1,186 @@
+"""Hardening tests (ADVICE r2 / VERDICT r2 #9): loud multi-host init
+failures, checkpoint shape-mismatch diagnostics, stable tokenizer output
+types, and the flash-kernel sequence-sharding warning."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpukit import checkpoint as ckpt_lib
+from tpukit import mesh as mesh_lib
+from tpukit.model import GPTConfig, init_params
+
+
+# ---------------------------------------------------------------------------
+# initialize_runtime must not silently degrade (VERDICT r2 weak #8)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def fresh_runtime(monkeypatch):
+    monkeypatch.setattr(mesh_lib, "_initialized", False)
+    yield
+    mesh_lib._initialized = True  # never re-run real init in later tests
+
+
+def test_initialize_runtime_raises_on_explicit_coordinator(monkeypatch, fresh_runtime):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("connection refused")),
+    )
+    with pytest.raises(RuntimeError, match="JAX_COORDINATOR_ADDRESS"):
+        mesh_lib.initialize_runtime()
+
+
+def test_initialize_runtime_tolerates_already_initialized(monkeypatch, fresh_runtime):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("distributed.initialize has already been called")
+        ),
+    )
+    mesh_lib.initialize_runtime()  # must not raise
+    assert mesh_lib._initialized
+
+
+# ---------------------------------------------------------------------------
+# Restore shape mismatches name vocab_pad_multiple (ADVICE r2 low #3)
+# ---------------------------------------------------------------------------
+
+
+def _params(pad_multiple):
+    cfg = GPTConfig(
+        dim=16, head_dim=8, heads=2, num_layers=1, vocab_size=97,
+        max_position_embeddings=32, vocab_pad_multiple=pad_multiple,
+    )
+    return init_params(jax.random.PRNGKey(0), cfg)
+
+
+def test_consolidated_restore_mismatch_names_vocab_padding(tmp_path):
+    path = ckpt_lib.save(_params(128), directory=tmp_path, name="padded")
+    with pytest.raises(ValueError, match="vocab_pad_multiple"):
+        ckpt_lib.restore(_params(1), path)
+
+
+def test_sharded_restore_mismatch_names_vocab_padding(tmp_path):
+    path = ckpt_lib.save_sharded(_params(128), directory=tmp_path, name="padded")
+    with pytest.raises(ValueError, match="vocab_pad_multiple"):
+        ckpt_lib.restore_sharded(path, _params(1))
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer output type is batch-size independent (ADVICE r2 low #4)
+# ---------------------------------------------------------------------------
+
+
+def test_tokenizer_padded_output_type_stable():
+    from tpukit.data import get_tokenizer
+
+    tok = get_tokenizer()
+    small = tok(["a cat", "a dog"], padding="max_length", truncation=True, max_length=8)
+    large = tok(["a cat sat"] * 80, padding="max_length", truncation=True, max_length=8)
+    for enc, n in ((small, 2), (large, 80)):
+        ids = np.asarray(enc["input_ids"])
+        mask = np.asarray(enc["attention_mask"])
+        assert isinstance(enc["input_ids"], np.ndarray)
+        assert ids.dtype == np.int32 and ids.shape == (n, 8)
+        assert mask.dtype == np.int32 and mask.shape == (n, 8)
+
+
+# ---------------------------------------------------------------------------
+# Flash kernel warns when a sharding would force a sequence all-gather
+# (ADVICE r2 low #5)
+# ---------------------------------------------------------------------------
+
+
+def test_flash_batch_head_spec_warns_on_seq_sharding():
+    from tpukit.ops.pallas_attention import _batch_head_spec
+
+    mesh = mesh_lib.create_mesh({"seq": 8})
+    seq_sharded = NamedSharding(mesh, P(None, None, "seq", None))
+    with pytest.warns(UserWarning, match="ring"):
+        spec = _batch_head_spec(seq_sharded, 4)
+    assert spec == P(None, None, None, None)
+
+    batch_sharded = NamedSharding(mesh, P("seq", None, None, None))
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        spec = _batch_head_spec(batch_sharded, 4)
+    assert spec == P("seq", None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# Sharded-save crash/re-save semantics (code-review r3)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_save_clears_stale_tmp(tmp_path):
+    """A crashed save leaves a .tmp dir at the (deterministic) step name;
+    the retry must not publish its leftover shard files."""
+    stale = tmp_path / "padded.sharded.tmp"
+    stale.mkdir(parents=True)
+    np.savez(stale / "shard-00099.npz", **{"0|0,0": np.ones((4, 4))})
+    params = _params(128)
+    path = ckpt_lib.save_sharded(params, directory=tmp_path, name="padded")
+    assert not (path / "shard-00099.npz").exists()
+    restored = ckpt_lib.restore_sharded(path, params)
+    np.testing.assert_array_equal(
+        np.asarray(restored["embeddings"]["token"]),
+        np.asarray(params["embeddings"]["token"]),
+    )
+
+
+def test_sharded_resave_replaces_contents(tmp_path):
+    """Saving again under the same name must publish the NEW data, not
+    silently keep the old directory."""
+    v1 = _params(128)
+    v2 = jax.tree.map(lambda x: x + 1.0, v1)
+    ckpt_lib.save_sharded(v1, directory=tmp_path, name="same")
+    path = ckpt_lib.save_sharded(v2, directory=tmp_path, name="same")
+    restored = ckpt_lib.restore_sharded(path, v1)
+    np.testing.assert_array_equal(
+        np.asarray(restored["embeddings"]["token"]),
+        np.asarray(v2["embeddings"]["token"]),
+    )
+    assert not path.with_name(path.name + ".tmp").exists()
+    assert not path.with_name(path.name + ".old").exists()
+
+
+def test_uneven_pipeline_checkpoint_cross_strategy_restore(tmp_path):
+    """Identity-padded pipeline checkpoints restore into unpadded templates
+    (padding sliced off) and vice versa (zero slots appended) — the
+    pipe -> single contract survives uneven layer counts."""
+    from tpukit.mesh import create_mesh
+    from tpukit.pipeline import Pipeline
+
+    cfg = GPTConfig(
+        dim=16, head_dim=8, heads=2, num_layers=3, vocab_size=97,
+        max_position_embeddings=32,
+    )
+    pipe = Pipeline(create_mesh({"stage": 2}), num_microbatches=2)
+    padded = pipe.prepare_params(init_params(jax.random.PRNGKey(0), cfg), cfg)
+    assert jax.tree.leaves(padded["layers"])[0].shape[0] == 4
+
+    # padded (4 slots) -> unpadded template (3 layers): padding sliced off
+    spath = ckpt_lib.save_sharded(padded, directory=tmp_path, name="padded-layers")
+    template = init_params(jax.random.PRNGKey(1), cfg)
+    restored = ckpt_lib.restore_sharded(spath, template)
+    jax.tree.map(
+        lambda r, p: np.testing.assert_array_equal(np.asarray(r), np.asarray(p)[:3]),
+        restored["layers"], padded["layers"],
+    )
+
+    # unpadded (3 layers) -> padded template (4 slots): zero slots appended
+    cpath = ckpt_lib.save(template, directory=tmp_path, name="unpadded")
+    restored2 = ckpt_lib.restore(padded, cpath)
+    for leaf, src in zip(
+        jax.tree.leaves(restored2["layers"]), jax.tree.leaves(template["layers"])
+    ):
+        np.testing.assert_array_equal(np.asarray(leaf)[:3], np.asarray(src))
+        assert (np.asarray(leaf)[3:] == 0).all()

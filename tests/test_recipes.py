@@ -78,6 +78,20 @@ def test_recipe_ring(tmp_path):
     _run_recipe("main-ring.py", tmp_path)
 
 
+def test_recipe_pipe_uneven_layers(tmp_path):
+    # 10 layers on 8 stages (VERDICT r2 #5): identity-padded to 16, trains
+    # end-to-end through fit() including generation and checkpointing
+    _run_recipe(
+        "main-pipe.py", tmp_path,
+        extra=["--num_layers", "10", "--microbatches", "8"],
+    )
+
+
+def test_recipe_tp(tmp_path):
+    # grid picker -> (data=2, model=4) on 8 devices with 4 heads
+    _run_recipe("main-tp.py", tmp_path)
+
+
 def test_recipe_fsdp_sharded_checkpoint_and_resume(tmp_path):
     """VERDICT r2 #1 done-criterion: a sharded recipe with --checkpoint_every
     writes a step-keyed .sharded dir and --resume latest restores from it."""

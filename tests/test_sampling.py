@@ -83,3 +83,38 @@ def test_cached_decode_matches_naive(tiny_config, tiny_params):
         cached = generate(params, cfg, prompt, tok, max_new_tokens=12, use_cache=True)
         naive = generate(params, cfg, prompt, tok, max_new_tokens=12, use_cache=False)
         assert cached == naive
+
+
+def test_generate_from_sharded_state(tiny_config):
+    """VERDICT r2 #2: generation must work from FSDP- and Pipeline-sharded
+    train state via the collective replication path (generate_samples), and
+    produce the same text as single-device params."""
+    from tpukit.data import get_tokenizer
+    from tpukit.mesh import create_mesh
+    from tpukit.model import init_params
+    from tpukit.pipeline import Pipeline
+    from tpukit.shardings import FSDP, SingleDevice
+    from tpukit.train import TrainState, generate_samples, make_optimizer
+
+    tok = get_tokenizer()
+    cfg = tiny_config.replace(
+        vocab_size=tok.vocab_size, max_position_embeddings=64, num_layers=3
+    )
+    opt = make_optimizer(1e-3)
+
+    def state_for(strategy):
+        params = strategy.prepare_params(init_params(jax.random.PRNGKey(3), cfg), cfg)
+        sharding = strategy.state_sharding(
+            TrainState(params=params, opt_state=opt.init(params), step=jnp.int32(0))
+        )
+        placed = jax.tree.map(jax.device_put, params, sharding.params)
+        return TrainState(params=placed, opt_state=None, step=jnp.int32(0))
+
+    reference = generate_samples(
+        SingleDevice(), state_for(SingleDevice()), cfg, tok, max_new_tokens=4
+    )
+    # 3 layers on 2 stages: also exercises the identity-padded uneven layout
+    for strategy in (FSDP(create_mesh({"data": 8})),
+                     Pipeline(create_mesh({"stage": 2}))):
+        texts = generate_samples(strategy, state_for(strategy), cfg, tok, max_new_tokens=4)
+        assert texts == reference, strategy.name

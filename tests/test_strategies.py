@@ -55,7 +55,7 @@ def batch(cfg):
 
 def _one_step(strategy, cfg, batch, targets):
     opt = make_optimizer(1e-3)
-    state = create_train_state(jax.random.PRNGKey(0), cfg, opt)
+    state = create_train_state(jax.random.PRNGKey(0), cfg, opt, strategy)
     shapes = jax.eval_shape(lambda: state)
     train_step, eval_step, _ = make_step_fns(cfg, opt, strategy, shapes)
     new_state, loss = train_step(state, batch, targets)
@@ -137,11 +137,44 @@ def test_pipe_dp_matches_single(cfg, batch, reference_step):
     _assert_matches_reference(_one_step(strategy, cfg, model_batch, targets), reference_step)
 
 
-def test_pipeline_rejects_undividable_layers(cfg, batch):
+def test_pipeline_rejects_unpadded_params(cfg, batch):
+    """Uneven layer counts are supported, but only through the identity-
+    padded init path — feeding raw unpadded params must fail loudly."""
+    from tpukit.model import init_params
+
     model_batch, targets = batch
-    strategy = Pipeline(create_mesh({"stage": 3}))
-    with pytest.raises(ValueError, match="must divide"):
-        strategy.loss_fn(None, cfg, model_batch, targets)
+    strategy = Pipeline(create_mesh({"stage": 3}), num_microbatches=4)
+    raw_params = init_params(jax.random.PRNGKey(0), cfg)  # 4 layers, not 6
+    with pytest.raises(ValueError, match="identity-padded"):
+        strategy.loss_fn(raw_params, cfg, model_batch, targets)
+
+
+def test_pipeline_uneven_layers_matches_single(cfg, batch, reference_step):
+    """VERDICT r2 #5: 4 layers on 3 stages (the reference's uneven-stage
+    arithmetic, main-pipe.py:52-68) trains and matches single-device exactly;
+    the identity-padding slots stay exactly zero through the update."""
+    model_batch, targets = batch
+    strategy = Pipeline(create_mesh({"stage": 3}), num_microbatches=4)
+    params, loss, eval_loss, eval_acc = _one_step(strategy, cfg, model_batch, targets)
+    ref_params, ref_loss, ref_eval_loss, ref_eval_acc = reference_step
+    assert abs(loss - ref_loss) < 1e-5
+    assert abs(eval_loss - ref_eval_loss) < 1e-2
+    assert abs(eval_acc - ref_eval_acc) < 1.0
+    # real layers (slots [:L]) take the single-device update
+    real = jax.tree.map(lambda t: t[: cfg.num_layers], params["layers"])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=5e-5, rtol=1e-4),
+        real, ref_params["layers"],
+    )
+    # padding slots received zero gradient and zero decay: still exactly 0
+    pad = jax.tree.map(lambda t: t[cfg.num_layers :], params["layers"])
+    assert all((np.asarray(x) == 0).all() for x in jax.tree.leaves(pad))
+    # embeddings / head / final norm match too
+    for key in ("embeddings", "norm_out", "lm_head"):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=5e-5, rtol=1e-4),
+            params[key], ref_params[key],
+        )
 
 
 def test_dp_batch_sharding_spec():
@@ -191,3 +224,33 @@ def test_fsdp_offload_memory_kind_rule(cfg):
     sharding = strategy.state_sharding(shapes)
     kinds = {s.memory_kind for s in jax.tree.leaves(sharding)}
     assert kinds == {"pinned_host"}
+
+
+def test_pipeline_param_memory(cfg):
+    """VERDICT r2 #3: embeddings/head are placed, not replicated — with 4
+    stages no device holds more than (layers/4 + max(emb, head)) parameter
+    bytes, and the vocab tables + their Adam state shard over `stage`."""
+    from jax.sharding import PartitionSpec as P
+
+    strategy = Pipeline(create_mesh({"stage": 4}))
+    opt = make_optimizer(1e-3)
+    state = create_train_state(jax.random.PRNGKey(0), cfg, opt, strategy)
+    sharding = jax.eval_shape(lambda: state)
+    sharding = strategy.state_sharding(sharding)
+    assert sharding.params["embeddings"]["token"].spec == P("stage", None)
+    assert sharding.params["lm_head"]["kernel"].spec == P(None, "stage")
+    assert sharding.params["embeddings"]["position"].spec == P()
+    # Adam state follows the same placement (mu/nu mirror the param paths)
+    assert sharding.opt_state[0].mu["embeddings"]["token"].spec == P("stage", None)
+    assert sharding.opt_state[0].nu["lm_head"]["kernel"].spec == P(None, "stage")
+
+    placed = jax.tree.map(jax.device_put, state.params, sharding.params)
+    per_device = {}
+    for leaf in jax.tree.leaves(placed):
+        for shard in leaf.addressable_shards:
+            per_device[shard.device] = per_device.get(shard.device, 0) + shard.data.nbytes
+    layers_bytes = sum(l.nbytes for l in jax.tree.leaves(state.params["layers"]))
+    emb = state.params["embeddings"]["token"].nbytes
+    head = state.params["lm_head"]["kernel"].nbytes
+    bound = layers_bytes / 4 + max(emb, head)
+    assert max(per_device.values()) < bound, (per_device, bound)

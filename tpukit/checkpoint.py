@@ -75,13 +75,72 @@ def save(state, directory: str | os.PathLike = "checkpoints", name: str | None =
     return path
 
 
+_VOCAB_PAD_HINT = (
+    "If the mismatched dimension is the vocab axis (e.g. 50257 vs 50304), "
+    "the checkpoint was written under a different GPTConfig.vocab_pad_multiple "
+    "— recreate the model with the checkpoint's padding (vocab_pad_multiple=1 "
+    "for an unpadded checkpoint, 128 for the default-padded one)."
+)
+
+
+def _adapt_layer_axis(path_str: str, arr, want: tuple):
+    """Cross-strategy restore of identity-padded pipeline checkpoints: an
+    uneven-layer Pipeline pads the stacked-layer axis to a stage multiple
+    with all-zero identity layers, real layers packed at the front
+    (tpukit/pipeline.py prepare_params). Restoring such a checkpoint into an
+    unpadded template slices the padding off; restoring an unpadded
+    checkpoint into a padded template appends zero slots. Returns the
+    adapted array, or None when the mismatch is not a layer-axis pad."""
+    import numpy as np
+
+    if "layers" not in path_str:
+        return None
+    arr = np.asarray(arr)
+    if arr.ndim == 0 or len(want) != arr.ndim or tuple(arr.shape[1:]) != tuple(want[1:]):
+        return None
+    saved, target = arr.shape[0], want[0]
+    if saved > target:
+        if np.any(arr[target:] != 0):
+            # not identity padding (e.g. a genuinely deeper model): refuse
+            # to silently drop trained layers
+            return None
+        return np.ascontiguousarray(arr[:target])
+    return np.concatenate(
+        [arr, np.zeros((target - saved, *arr.shape[1:]), arr.dtype)], axis=0
+    )
+
+
 def restore(template, path: str | os.PathLike):
     """Restore into the structure of `template` (a freshly-initialized train
     state). The caller re-applies the strategy's shardings by passing the
     result through the jitted step (or `jax.device_put` with the state
-    sharding)."""
+    sharding). Leaf shapes are validated against the template — flax's
+    from_bytes silently accepts mismatched array shapes in plain pytrees,
+    which would surface later as an opaque jit/sharding error."""
     blob = Path(path).read_bytes()
-    return serialization.from_bytes(template, blob)
+    try:
+        restored = serialization.from_bytes(template, blob)
+    except ValueError as exc:
+        if "shape" in str(exc).lower():
+            raise ValueError(f"{exc}\n{_VOCAB_PAD_HINT}") from exc
+        raise
+    t_flat = jax.tree_util.tree_flatten_with_path(template)[0]
+    r_leaves, r_def = jax.tree_util.tree_flatten(restored)
+    out, changed = [], False
+    for (keypath, t_leaf), r_leaf in zip(t_flat, r_leaves):
+        want = tuple(getattr(t_leaf, "shape", ()) or ())
+        got = tuple(getattr(r_leaf, "shape", ()) or ())
+        if want != got:
+            name = "/".join(str(k) for k in keypath)
+            adapted = _adapt_layer_axis(name, r_leaf, want)
+            if adapted is None:
+                raise ValueError(
+                    f"checkpoint {path}: leaf {name} was saved with shape "
+                    f"{got} but the target expects {want}. {_VOCAB_PAD_HINT}"
+                )
+            r_leaf, changed = adapted, True
+        out.append(r_leaf)
+    return jax.tree_util.tree_unflatten(r_def, out) if changed else restored
 
 
 def latest(directory: str | os.PathLike = "checkpoints") -> Path | None:
@@ -193,6 +252,15 @@ def save_sharded(state, directory: str | os.PathLike = "checkpoints", name: str 
     # per-process wall clock — all processes must agree on the directory.
     base = Path(directory).resolve() / ((name or step_name(state)) + ".sharded")
     tmp = base.with_name(base.name + ".tmp")
+    # A crashed save at the same step leaves a stale tmp dir (names are
+    # deterministic per step); its leftover shard files would otherwise be
+    # published alongside the fresh ones and corrupt the restore. Process 0
+    # clears it before anyone writes.
+    if is_process_zero() and tmp.exists():
+        import shutil
+
+        shutil.rmtree(tmp)
+    sync_global_devices("sharded_ckpt_tmp_cleared")
     # Every process mkdirs (exist_ok): on a shared filesystem this is
     # idempotent, and it removes the process-0-wins race where a slow mkdir
     # let other processes' np.savez fail on a missing directory.
@@ -221,14 +289,41 @@ def save_sharded(state, directory: str | os.PathLike = "checkpoints", name: str 
         (tmp / "manifest.json").write_text(json.dumps(manifest))
     sync_global_devices("sharded_ckpt_written")
     if is_process_zero():
-        if base.exists():
-            # re-save of the same step (e.g. final save right after a
-            # periodic one): keep the existing published checkpoint
+        if not base.exists():
+            tmp.rename(base)  # atomic publish
+        elif name is None:
+            # Step-keyed re-save (the final save right after a periodic one
+            # at the same step): within one run the state at a given step is
+            # deterministic, so the published directory already holds these
+            # bytes — keep it rather than opening a window with no valid
+            # checkpoint (a directory swap cannot be atomic; pod preemption
+            # mid-swap would destroy the previously durable checkpoint).
+            # Warn in case the directory is a leftover from a DIFFERENT run
+            # (same step, different config) — that stale state would win.
             import shutil
+            import warnings
 
+            warnings.warn(
+                f"sharded checkpoint {base} already exists; keeping the "
+                f"published directory (same-step re-save). If this is a "
+                f"fresh run reusing an old checkpoints dir, clear it first "
+                f"— --resume latest would restore the OLD run's state.",
+                stacklevel=2,
+            )
             shutil.rmtree(tmp)
         else:
-            tmp.rename(base)  # atomic publish
+            # Explicitly named re-save: the caller is deliberately reusing a
+            # name with (possibly) new contents — swap the fresh data in.
+            # Not crash-atomic (directories cannot be rename-replaced), but
+            # this path is never taken by the train loop.
+            import shutil
+
+            trash = base.with_name(base.name + ".old")
+            if trash.exists():
+                shutil.rmtree(trash)
+            base.rename(trash)
+            tmp.rename(base)
+            shutil.rmtree(trash)
     sync_global_devices("sharded_ckpt_published")
     return base
 
@@ -243,7 +338,10 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
     """Restore a sharded checkpoint into the structure of `template`,
     placing each leaf with `sharding_tree` (defaults to the template
     leaves' own shardings). The target shardings need not match the ones
-    the checkpoint was written under."""
+    the checkpoint was written under, and identity-padded stacked-layer
+    axes (uneven pipeline layouts) are sliced/zero-padded to the template's
+    layer count (_adapt_layer_axis) — so pipe -> single restores work even
+    for uneven layer counts."""
     import json
 
     import numpy as np
@@ -269,6 +367,7 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
     restored = []
     for i, (leaf, meta, sharding) in enumerate(zip(flat, manifest["leaves"], shardings)):
         shape, dtype = tuple(meta["shape"]), np.dtype(meta["dtype"])
+        want = tuple(getattr(leaf, "shape", shape))
         full = np.empty(shape, dtype)
         covered = 0  # blocks are disjoint by construction (replica_id==0)
         prefix = f"{i}|"
@@ -295,6 +394,15 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
                 f"missing (saved from {manifest['nprocs']} processes; are "
                 f"all shard files on this filesystem?)"
             )
+        if want != shape:
+            adapted = _adapt_layer_axis(manifest["paths"][i], full, want)
+            if adapted is None:
+                raise ValueError(
+                    f"checkpoint {base}: leaf {i} ({manifest['paths'][i]}) "
+                    f"was saved with shape {shape} but the target expects "
+                    f"{want}. {_VOCAB_PAD_HINT}"
+                )
+            full, shape = adapted, want
         if sharding is not None:
             restored.append(
                 jax.make_array_from_callback(shape, sharding, lambda idx, f=full: f[idx])

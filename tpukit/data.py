@@ -188,8 +188,17 @@ class WordTokenizer:
         if truncation:
             encoded = [ids[:max_length] for ids in encoded]
         if padding == "max_length":
-            input_ids = [ids + [self.pad_token_id] * (max_length - len(ids)) for ids in encoded]
-            attention_mask = [[1] * len(ids) + [0] * (max_length - len(ids)) for ids in encoded]
+            # Stable output contract regardless of which encoder ran: the
+            # padded path always yields [N, max_length] int32 arrays (the
+            # native encoder's type), never Python lists.
+            input_ids = np.asarray(
+                [ids + [self.pad_token_id] * (max_length - len(ids)) for ids in encoded],
+                dtype=np.int32,
+            )
+            attention_mask = np.asarray(
+                [[1] * len(ids) + [0] * (max_length - len(ids)) for ids in encoded],
+                dtype=np.int32,
+            )
         else:
             input_ids = encoded
             attention_mask = [[1] * len(ids) for ids in encoded]

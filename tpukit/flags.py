@@ -39,6 +39,10 @@ class TrainFlags:
     cpu_offload: bool = False
     # tpukit extensions (absent in the reference; see SURVEY §5 plans):
     seed: int = 0
+    # Dropout rate (the reference model takes it as a constructor arg but its
+    # CLIs never expose it, models/gpt.py:14,50; here it is a flag). Active
+    # in train steps only, seeded per step from --seed.
+    dropout: float = 0.0
     checkpoint_every: int = 0  # steps; 0 = end-of-training only (reference behavior)
     # "auto" writes the sharded format exactly when the state cannot be
     # host-gathered (multi-host FSDP/pipeline), else the consolidated
@@ -89,6 +93,7 @@ def build_parser(cpu_offload: bool = False) -> argparse.ArgumentParser:
     if cpu_offload:
         parser.add_argument("--cpu_offload", action="store_true")
     parser.add_argument("--seed", type=int, default=defaults.seed)
+    parser.add_argument("--dropout", type=float, default=defaults.dropout)
     parser.add_argument("--checkpoint_every", type=int, default=defaults.checkpoint_every)
     parser.add_argument(
         "--checkpoint_format",

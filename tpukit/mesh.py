@@ -32,11 +32,31 @@ def initialize_runtime() -> None:
     global _initialized
     if _initialized:
         return
-    if jax.process_count() > 1 or os.environ.get("JAX_COORDINATOR_ADDRESS"):
+    explicit = bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if jax.process_count() > 1 or explicit:
         try:
             jax.distributed.initialize()
-        except Exception:
-            pass  # already initialized by the launcher/runtime
+        except Exception as exc:
+            if "already" in str(exc).lower():
+                pass  # initialized by the launcher/runtime — fine
+            elif explicit:
+                # The operator asked for a multi-host run. Silently falling
+                # back would train N independent single-host copies — the
+                # worst possible failure mode on a pod. Fail loudly instead.
+                raise RuntimeError(
+                    "JAX_COORDINATOR_ADDRESS is set but "
+                    "jax.distributed.initialize() failed; refusing to "
+                    "silently degrade to independent single-host training. "
+                    f"Original error: {exc}"
+                ) from exc
+            else:
+                import warnings
+
+                warnings.warn(
+                    f"jax.distributed.initialize() failed ({exc}); "
+                    "continuing single-host",
+                    stacklevel=2,
+                )
     _initialized = True
 
 
@@ -69,6 +89,19 @@ def create_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
     if total > n:
         raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, have {n}")
     return Mesh(devices[:total].reshape(sizes), names)
+
+
+def place_host_array(x, sharding):
+    """Place a host array at `sharding`, multi-host safe: single-process
+    uses `device_put`; multi-process builds the global array from each
+    host's addressable shards (`device_put` onto a sharding spanning
+    non-addressable devices would raise). Every process must call this with
+    the same value. Shared by checkpoint restore, resume placement and the
+    decode-buffer path."""
+    x = np.asarray(x)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx, x=x: x[idx])
 
 
 def device_kind() -> str:

@@ -256,11 +256,17 @@ def apply_decoder_layer(layer: Params, cfg: GPTConfig, x, pad_mask, rng=None, de
 
 
 def apply_decoder_layers(
-    stacked_layers: Params, cfg: GPTConfig, x, pad_mask, rng=None, deterministic=True
+    stacked_layers: Params, cfg: GPTConfig, x, pad_mask, rng=None, deterministic=True,
+    active=None,
 ) -> jax.Array:
     """Sequential layer stack (models/gpt.py:161-167) over the stacked layer
     parameters. Works for any leading stack size, so pipeline stages call it
     on their `[layers_per_stage, ...]` slice.
+
+    `active` (optional bool [num]): per-slot gate for padding layers in
+    uneven pipeline layouts — an inactive slot passes `x` through unchanged
+    and its parameters receive zero gradient (the `where` selects the
+    residual stream, so the layer branch is dead in the backward pass).
 
     Execution is controlled by cfg.scan_layers (unrolled blocks vs one
     lax.scan body) and cfg.remat_layers (checkpoint each layer); see the
@@ -285,19 +291,28 @@ def apply_decoder_layers(
     if not cfg.scan_layers:
         for i in range(num):
             layer = jax.tree_util.tree_map(lambda t: t[i], stacked_layers)
-            x = layer_fn(
+            y = layer_fn(
                 layer, cfg, x, pad_mask, rngs[i] if use_rng else None, deterministic
             )
+            x = y if active is None else jnp.where(active[i], y, x)
         return x
 
+    if active is None:
+        active = jnp.ones((num,), dtype=bool)
+        gate = False
+    else:
+        gate = True
+
     def body(carry, scanned):
-        layer, layer_rng = scanned
+        layer, layer_rng, act = scanned
         out = layer_fn(
             layer, cfg, carry, pad_mask, layer_rng if use_rng else None, deterministic
         )
+        if gate:
+            out = jnp.where(act, out, carry)
         return out, None
 
-    x, _ = jax.lax.scan(body, x, (stacked_layers, rngs))
+    x, _ = jax.lax.scan(body, x, (stacked_layers, rngs, active))
     return x
 
 

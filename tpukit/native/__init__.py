@@ -7,16 +7,21 @@ this package: a multithreaded C++ batch tokenizer (tokenizer.cpp) exactly
 twinning `WordTokenizer`'s encoding, loaded through ctypes (pybind11 is
 deliberately not required).
 
-Build model: the shared library compiles lazily on first use with g++
-(cached next to the source, rebuilt when the .cpp is newer). Environments
-without a compiler simply fall back to the pure-Python encoder —
-`is_available()` gates every caller. Set TPUKIT_NATIVE=0 to force the
-Python path.
+Build model: the shared library compiles lazily on first use with g++ and
+is never committed — only `tokenizer.cpp` is source of truth. A sidecar
+hash file records which source the cached .so was built from; any source
+change (or a stale/foreign binary) triggers a rebuild, so the binary that
+executes is always the one auditable from the checked-in C++ (mtime
+comparison is useless after a fresh `git checkout`, which assigns equal
+mtimes). Environments without a compiler simply fall back to the
+pure-Python encoder — `is_available()` gates every caller. Set
+TPUKIT_NATIVE=0 to force the Python path.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 from pathlib import Path
@@ -26,18 +31,32 @@ import numpy as np
 _DIR = Path(__file__).resolve().parent
 _SRC = _DIR / "tokenizer.cpp"
 _LIB = _DIR / "libtpukit_native.so"
+_HASH = _DIR / ".libtpukit_native.srchash"
 
 _lib = None
 _build_error: str | None = None
 
 
+def _src_hash() -> str:
+    return hashlib.sha256(_SRC.read_bytes()).hexdigest()
+
+
 def _build() -> None:
+    # Compile to a per-process temp path and atomically publish: several
+    # processes may race to build after a fresh checkout (the .so is not
+    # committed), and a reader must never dlopen a partially-written file.
+    out = _DIR / f".build-{os.getpid()}.so.tmp"
     cmd = [
         os.environ.get("CXX", "g++"),
         "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        str(_SRC), "-o", str(_LIB),
+        str(_SRC), "-o", str(out),
     ]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(out, _LIB)
+    finally:
+        out.unlink(missing_ok=True)
+    _HASH.write_text(_src_hash())
 
 
 def _load():
@@ -48,7 +67,8 @@ def _load():
         _build_error = "disabled via TPUKIT_NATIVE=0"
         return None
     try:
-        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+        recorded = _HASH.read_text().strip() if _HASH.exists() else ""
+        if not _LIB.exists() or recorded != _src_hash():
             _build()
         lib = ctypes.CDLL(str(_LIB))
         lib.tpukit_tok_create.restype = ctypes.c_void_p

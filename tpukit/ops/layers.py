@@ -10,8 +10,11 @@ the loss run in float32.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 IGNORE_INDEX = -100  # twin of torch F.cross_entropy ignore_index (reference main-single.py:96)
 
@@ -117,6 +120,115 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """
     loss_sum, count = cross_entropy_sum(logits, targets)
     return loss_sum / jnp.maximum(count, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Vocab-parallel primitives (used by the pipeline's sharded embedding / head,
+# tpukit/pipeline.py). No reference counterpart: the reference replicates the
+# full embedding table and head on every pipeline stage via torch Pipe's
+# module placement (main-pipe.py:75-77 puts them on first/last GPU but the
+# optimizer state still rides each stage's module copy); here the vocab
+# dimension is sharded over the `stage` mesh axis so no device ever holds a
+# full table.
+# ---------------------------------------------------------------------------
+
+
+def _psum_bcast_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _psum_bcast_bwd(axis, _, ct):
+    return (jax.lax.psum(ct, axis),)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_bcast(x: jax.Array, axis):
+    """`lax.psum` whose transpose is also a psum.
+
+    Inside shard_map, JAX transposes psum to an identity per device, which is
+    only correct when the cotangent is device-invariant. Here the summed
+    value is consumed *divergently* (e.g. only the last pipeline stage's CE
+    contribution is nonzero at a given schedule step), so the mathematically
+    correct input cotangent is the sum of every device's output cotangent —
+    exactly Megatron's paired f/g collectives, written as one custom VJP.
+    """
+    return jax.lax.psum(x, axis)
+
+
+psum_bcast.defvjp(_psum_bcast_fwd, _psum_bcast_bwd)
+
+
+def _vp_terms(local_logits, targets, offset, axis):
+    lf = local_logits.astype(jnp.float32)
+    v_local = lf.shape[-1]
+    valid = targets != IGNORE_INDEX
+    rel = jnp.where(valid, targets, 0) - offset
+    own = valid & (rel >= 0) & (rel < v_local)
+    safe = jnp.where(own, rel, 0)
+
+    gmax = jax.lax.pmax(jnp.max(lf, axis=-1), axis)
+    sumexp = jax.lax.psum(jnp.sum(jnp.exp(lf - gmax[..., None]), axis=-1), axis)
+    lse = gmax + jnp.log(sumexp)
+    target_logit = jax.lax.psum(
+        jnp.where(own, jnp.take_along_axis(lf, safe[..., None], axis=-1)[..., 0], 0.0),
+        axis,
+    )
+    loss_sum = jnp.sum(jnp.where(valid, lse - target_logit, 0.0))
+    count = jnp.sum(valid).astype(jnp.float32)
+    return loss_sum, count, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def vocab_parallel_ce(local_logits: jax.Array, targets: jax.Array, offset, axis):
+    """(loss_sum, valid_count) of the global cross-entropy, computed from
+    vocab-sharded logits — each device holds `[..., V/axis_size]` columns
+    starting at `offset`. Must be called by every device on the axis (the
+    reductions are collective). Returns the same (replicated) values on
+    every device.
+
+    The backward pass is local: `(softmax - onehot) * g` per vocab slice
+    from the saved global logsumexp — no full-vocab tensor and no backward
+    collectives (the Megatron vocab-parallel CE), mirroring
+    `cross_entropy_sum`'s memory design.
+    """
+    loss_sum, count, _ = _vp_terms(local_logits, targets, offset, axis)
+    return loss_sum, count
+
+
+def _vp_fwd(local_logits, targets, offset, axis):
+    loss_sum, count, lse = _vp_terms(local_logits, targets, offset, axis)
+    return (loss_sum, count), (local_logits, targets, offset, lse)
+
+
+def _vp_bwd(axis, residuals, g):
+    local_logits, targets, offset, lse = residuals
+    # The CE returns the same replicated loss_sum on every device of `axis`,
+    # and callers typically accumulate it on every device and psum — so the
+    # cotangent arriving HERE is 1/axis_size of the logical loss cotangent
+    # (shard_map transposes psum to a per-device identity). Summing it over
+    # the axis recovers the full cotangent regardless of how the caller
+    # distributed it; the local gradient formula below then needs no
+    # backward collective on the logits themselves.
+    g_sum = jax.lax.psum(g[0], axis)  # count depends only on (non-diff) targets
+    lf = local_logits.astype(jnp.float32)
+    v_local = lf.shape[-1]
+    valid = targets != IGNORE_INDEX
+    rel = jnp.where(valid, targets, 0) - offset
+    own = valid & (rel >= 0) & (rel < v_local)
+    probs = jnp.exp(lf - lse[..., None])
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (v_local,), 0)
+        == jnp.where(own, rel, -1)[..., None]
+    )
+    dlocal = (probs - onehot.astype(jnp.float32)) * jnp.where(valid, g_sum, 0.0)[..., None]
+    return (
+        dlocal.astype(local_logits.dtype),
+        np.zeros(targets.shape, jax.dtypes.float0),
+        np.zeros(jnp.shape(offset), jax.dtypes.float0),
+    )
+
+
+vocab_parallel_ce.defvjp(_vp_fwd, _vp_bwd)
 
 
 def masked_accuracy(logits: jax.Array, targets: jax.Array) -> jax.Array:

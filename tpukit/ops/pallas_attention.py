@@ -373,12 +373,26 @@ def _bwd4_impl(q, k, v, mask, out, lse, do, scale, heads):
 
 def _batch_head_spec(sharding, ndim):
     """Partition spec keeping only batch(0)/head(1) shardings; S and
-    head_dim must be whole on every device for the kernel math."""
+    head_dim must be whole on every device for the kernel math. Dropping a
+    sequence/head_dim sharding means GSPMD will all-gather those axes per
+    device — a silent memory/perf cliff for context-sharded configs, so it
+    warns (seq sharding belongs on the ring-attention path, not here)."""
     from jax.sharding import PartitionSpec as P
 
     if sharding is None or not hasattr(sharding, "spec"):
         return P()
     spec = list(sharding.spec) + [None] * (ndim - len(sharding.spec))
+    if any(spec[2:ndim]):
+        import warnings
+
+        warnings.warn(
+            f"flash attention: input sharded over sequence/head_dim "
+            f"({sharding.spec}); the kernel keeps those axes whole per "
+            f"device, so GSPMD will all-gather them (replicating S per "
+            f"device). Use attention_impl='ring' (ContextParallel) for "
+            f"sequence sharding.",
+            stacklevel=2,
+        )
     return P(*(tuple(spec[: min(2, ndim)]) + (None,) * (ndim - 2)))
 
 

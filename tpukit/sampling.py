@@ -93,6 +93,28 @@ def _decode_loop_cached(params, cfg: gpt.GPTConfig, buf, prompt_len: int, max_ne
     return buf, cur
 
 
+def _replicate_like(params, buf):
+    """Place the decode buffer replicated on the params' mesh. Plain
+    `jnp.asarray` would commit it to a single device, which is invalid for
+    a multi-host SPMD decode (every process must hold the same global,
+    fully-addressable-per-host value)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tpukit.mesh import place_host_array
+
+    sh = next(
+        (
+            leaf.sharding
+            for leaf in jax.tree_util.tree_leaves(params)
+            if isinstance(getattr(leaf, "sharding", None), NamedSharding)
+        ),
+        None,
+    )
+    if sh is None:
+        return jnp.asarray(buf)
+    return place_host_array(buf, NamedSharding(sh.mesh, PartitionSpec()))
+
+
 def generate(
     params,
     cfg: gpt.GPTConfig,
@@ -127,7 +149,7 @@ def generate(
         use_cache = buf.shape[1] >= 512
     loop = _decode_loop_cached if use_cache else _decode_loop
     buf, length = loop(
-        params, cfg, jnp.asarray(buf), prompt_len, max_new_tokens, int(eos)
+        params, cfg, _replicate_like(params, buf), prompt_len, max_new_tokens, int(eos)
     )
     out_ids = np.asarray(buf)[0, : int(length)]
     return tokenizer.decode(out_ids, skip_special_tokens=True)

@@ -75,6 +75,13 @@ class Strategy:
         cpu_offload)."""
         return state
 
+    def prepare_params(self, params, cfg: gpt.GPTConfig):
+        """Hook run once at init: adapt freshly-initialized parameters to the
+        strategy's layout. Identity for every strategy except Pipeline, which
+        pads the stacked layers to a stage multiple (see
+        Pipeline.prepare_params)."""
+        return params
+
     def validate_config(self, cfg: gpt.GPTConfig) -> None:
         """Raise a clear error before any tracing when the model shape cannot
         map onto this strategy's mesh (divisibility constraints)."""
@@ -92,16 +99,25 @@ class Strategy:
 
     # -- loss --------------------------------------------------------------
 
-    def loss_fn(self, params, cfg: gpt.GPTConfig, batch, targets, with_accuracy: bool = False):
+    def loss_fn(
+        self, params, cfg: gpt.GPTConfig, batch, targets,
+        with_accuracy: bool = False, rng=None,
+    ):
         """Default forward + masked CE (+ masked accuracy for eval).
 
         Under a sharded batch this single jitted function IS the distributed
         step: the mean over the global batch is the twin of DDP's gradient
         all-reduce and of the explicit eval `dist.all_reduce(..., AVG)`
         (main-ddp.py:159-160) — GSPMD inserts the psum.
+
+        `rng` is the per-step dropout key (None = deterministic, the eval
+        path). Under GSPMD the global mask is generated once and sharded
+        (threefry is partitionable), so dropout is consistent across DP/FSDP
+        shards — the twin of torch dropout running under DDP.
         """
         logits = gpt.forward(
-            params, cfg, batch["input_ids"], batch["position_ids"], batch["mask"]
+            params, cfg, batch["input_ids"], batch["position_ids"], batch["mask"],
+            rng=rng, deterministic=rng is None,
         )
         loss = cross_entropy_loss(logits, targets)
         accuracy = masked_accuracy(logits, targets) if with_accuracy else jnp.float32(0)
@@ -242,7 +258,10 @@ class ContextParallel(Strategy):
                 f"shards; pick sequence_length = k*{self.seq_size} + 1"
             )
 
-    def loss_fn(self, params, cfg: gpt.GPTConfig, batch, targets, with_accuracy: bool = False):
+    def loss_fn(
+        self, params, cfg: gpt.GPTConfig, batch, targets,
+        with_accuracy: bool = False, rng=None,
+    ):
         seq_len = batch["input_ids"].shape[1]
         if seq_len % self.seq_size:
             raise ValueError(
@@ -256,8 +275,20 @@ class ContextParallel(Strategy):
         from jax import shard_map
 
         def local_loss(params, input_ids, position_ids, mask, tgts):
+            if rng is None:
+                local_rng = None
+            else:
+                # independent dropout mask per mesh position: fold the
+                # shard's linearized mesh index into the step key
+                lin = jnp.int32(0)
+                for ax in axes:
+                    lin = lin * self.mesh.shape[ax] + jax.lax.axis_index(ax)
+                local_rng = jax.random.fold_in(rng, lin)
             x = gpt.apply_embeddings(params, local_cfg, input_ids, position_ids)
-            x = gpt.apply_decoder_layers(params["layers"], local_cfg, x, mask)
+            x = gpt.apply_decoder_layers(
+                params["layers"], local_cfg, x, mask,
+                rng=local_rng, deterministic=local_rng is None,
+            )
             # custom-VJP sum: no f32 [B, S, V] tensor in either direction
             # (tpukit/ops/layers.py cross_entropy_sum)
             logits = gpt.apply_head(params, local_cfg, x)
@@ -313,12 +344,15 @@ class TensorParallel(Strategy):
     def batch_spec(self) -> P:
         return P("data") if "data" in self.mesh.axis_names else P()
 
-    def loss_fn(self, params, cfg: gpt.GPTConfig, batch, targets, with_accuracy: bool = False):
+    def loss_fn(
+        self, params, cfg: gpt.GPTConfig, batch, targets,
+        with_accuracy: bool = False, rng=None,
+    ):
         # The fused qkv matmul would concatenate kernels along their sharded
         # (column) axis, forcing a weight re-layout every step — keep the
         # three Megatron column-parallel matmuls instead.
         return super().loss_fn(
-            params, cfg.replace(fuse_qkv=False), batch, targets, with_accuracy
+            params, cfg.replace(fuse_qkv=False), batch, targets, with_accuracy, rng
         )
 
     def _spec_for(self, names: tuple[str, ...], shape: tuple[int, ...]) -> P:

@@ -120,6 +120,44 @@ def test_block_plan_alignment():
             assert block % 16 == 0 and block == seq_pad
 
 
+def test_multiblock_fused_and_split_backward(monkeypatch):
+    """Multi-block grads on BOTH backward variants: the fused dkv+dq-partials
+    kernel (num_k <= _DQ_FUSED_MAX_NUM_K) and the split two-kernel path that
+    takes over for long sequences (no S^2-scaled dq partials in HBM). Block
+    size is pinned to 128 so a 384-token sequence spans 3 blocks."""
+    import tpukit.ops.pallas_attention as pa
+
+    monkeypatch.setattr(pa, "_BLOCK", 128)
+    rng = np.random.RandomState(7)
+    s = 384
+    q, k, v = (jnp.asarray(rng.randn(1, 2, s, D), jnp.float32) for _ in range(3))
+    mask = jnp.zeros((1, s), dtype=bool).at[0, 370:].set(True)
+
+    def loss(fn):
+        def f(q, k, v):
+            out = fn(q, k, v, scale=SCALE, pad_mask=mask)
+            return jnp.sum(jnp.where(~mask[:, None, :, None], out, 0.0) ** 2)
+        return f
+
+    g_ref = jax.grad(loss(causal_attention), argnums=(0, 1, 2))(q, k, v)
+
+    assert pa._DQ_FUSED_MAX_NUM_K >= 3  # 3 blocks ride the fused kernel
+    g_fused = jax.grad(loss(flash_causal_attention), argnums=(0, 1, 2))(q, k, v)
+    for ours, ref, name in zip(g_fused, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(ours), np.asarray(ref), atol=5e-4, rtol=1e-3,
+            err_msg=f"fused d{name} mismatch",
+        )
+
+    monkeypatch.setattr(pa, "_DQ_FUSED_MAX_NUM_K", 1)  # force the split path
+    g_split = jax.grad(loss(flash_causal_attention), argnums=(0, 1, 2))(q, k, v)
+    for ours, ref, name in zip(g_split, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(ours), np.asarray(ref), atol=5e-4, rtol=1e-3,
+            err_msg=f"split d{name} mismatch",
+        )
+
+
 def test_auto_dispatch_gspmd_safe():
     """Under GSPMD-sharded jit on a multi-device mesh, impl='auto' is
     sharded-correct (on the CPU test backend it picks the XLA path; on TPU

@@ -380,6 +380,25 @@ def forward_cached(params: Params, cfg: GPTConfig, input_ids, position_ids, cach
     return apply_head(params, cfg, x), cache
 
 
+def forward_hidden(
+    params: Params,
+    cfg: GPTConfig,
+    input_ids: jax.Array,
+    position_ids: jax.Array,
+    mask: jax.Array | None = None,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Everything up to (and including) the final LayerNorm — the hidden
+    states the LM head consumes. Split out so the fused head+CE kernel
+    (tpukit/ops/fused_head_ce.py) can take over from here without the
+    logits ever materializing; `forward` == `apply_head`-minus-norm of
+    this."""
+    x = apply_embeddings(params, cfg, input_ids, position_ids)
+    x = apply_decoder_layers(params["layers"], cfg, x, mask, rng, deterministic)
+    return layer_norm(x, params["norm_out"]).astype(cfg.compute_dtype)
+
+
 def apply_head(params: Params, cfg: GPTConfig, x) -> jax.Array:
     """Final LayerNorm + untied lm_head (models/gpt.py:217-219,229-231).
 

@@ -4,24 +4,26 @@ Replaces the O(S^2)-memory attention of the reference (`models/gpt.py:79-99`
 materializes the full `[B, h, S, S]` score tensor; its own TODO at
 models/gpt.py:81-82 flags the cost). These kernels stream K/V blocks through
 VMEM with an online softmax, so no S x S tensor ever touches HBM — forward
-writes only the output and a log-sum-exp vector, and the backward kernels
-recompute scores blockwise.
+writes only the output and a log-sum-exp vector; the backward is ONE fused
+kernel that recomputes each score block once and emits dk/dv (VMEM-scratch
+accumulated) plus per-k-block dq partials (see _bwd_kernel).
 
 Masking semantics mirror tpukit/ops/attention.py (and therefore the
-reference) exactly: the causal constraint is a -1e9 additive term and the
-padding mask overwrites key columns with float32 finfo.min afterwards, so a
-fully-padded query row softmaxes uniformly rather than NaN-ing. One
-documented divergence: for a *fully padded* query row the XLA path attends
-uniformly over all S positions (the reference's masked_fill overwrites the
-causal term, models/gpt.py:90-95) while the kernel attends uniformly over
-j <= i; such rows carry ignore-index targets and never affect the loss.
+reference): causally-forbidden entries are suppressed (select to -1e9) and
+the padding mask adds a float32 finfo.min bias to key columns, so a
+fully-padded query row softmaxes uniformly rather than NaN-ing (see
+_masked_scores for the exact-equivalence argument). One documented
+divergence: for a *fully padded* query row the XLA path attends uniformly
+over all S positions (the reference's masked_fill overwrites the causal
+term, models/gpt.py:90-95) while the kernel attends uniformly over j <= i;
+such rows carry ignore-index targets and never affect the loss.
 
 Layout: grid (batch*heads, q_blocks, k_blocks) with the k dimension
 innermost; running (m, l, acc) state lives in VMEM scratch across k steps
 (TPU grids execute sequentially). Causally-skipped blocks are gated with
 `pl.when` and their K/V fetches are clamped to the diagonal block so no
 wasted HBM traffic occurs. Per-row vectors ride in Mosaic-friendly 2-D
-layouts: the padding mask as a [B, 1, S_pad] row, log-sum-exp and the dO.O
+layouts: the padding bias as a [B, 1, S_pad] row, log-sum-exp and the dO.O
 row sums as [BH, S_pad, 1] columns — every ref read/write stays rank>=2
 (rank-1 slices crash the Mosaic layout pass), and block shapes are
 (8, 128)-tile aligned or span their dimension.
@@ -63,6 +65,18 @@ def _interpret() -> bool:
     return not on_tpu_backend()
 
 
+def tpu_compiler_params(*dimension_semantics: str):
+    """Shared CompilerParams for every tpukit Pallas kernel (None in
+    interpreter mode): one place to tune the VMEM budget, imported by
+    fused_head_ce too."""
+    if _interpret():
+        return None
+    return pltpu.CompilerParams(
+        vmem_limit_bytes=100 * 1024 * 1024,
+        dimension_semantics=dimension_semantics,
+    )
+
+
 def _plan(seq: int) -> tuple[int, int]:
     """(block, seq_pad) for a given sequence length. Mosaic requires the
     score-block edge and the padded sequence to be lane-aligned: for
@@ -78,21 +92,51 @@ def _plan(seq: int) -> tuple[int, int]:
     return block, seq_pad
 
 
-def _masked_scores(q_blk, k_blk, mask_ref, scale, qi, ki, block_q, block_k):
-    """[BQ, BK] float32 scores with causal + padding masks applied, matching
-    the XLA path's order of operations. `mask_ref` is the [1, 1, S_pad] int32
-    padding-row ref; the ki-th block is sliced at the ref level as (1, BK)."""
+def _masked_scores(q_blk, k_blk, bias_ref, qi, ki, block_q, block_k, has_mask):
+    """[BQ, BK] float32 scores with causal + padding masks applied.
+
+    The kernels are VPU-bound at small head_dim (the two matmuls have K or
+    N = head_dim, a fraction of the MXU, while every mask/softmax op sweeps
+    the full BQ x BK block), so this routine minimizes elementwise passes:
+
+      - `scale` is folded into q by the wrappers (zero passes here);
+      - the causal select compares LOCAL iotas against the block-offset
+        difference (off-diagonal lower blocks reduce to an always-true
+        compare the VPU predicates cheaply; a measured lax.cond variant
+        that skipped them entirely was SLOWER — the conditional copies the
+        4MB score block through both branches);
+      - padding is one broadcast ADD of a precomputed float32 bias row
+        (0 or finfo.min), not an int compare + select, and is compiled out
+        entirely when the caller passed no mask (`has_mask` static).
+    Ablations on v5e show the kernel is MXU-latency-bound (the matmuls'
+    K or N = head_dim fills 1/4 of the array): mask/exp/reduction passes
+    overlap with the MXU and cost ~nothing, so this routine optimizes for
+    fewer serialized VPU passes, not minimum arithmetic.
+
+    Numerics equivalence with the old compare/overwrite form: a bias of
+    finfo.min sends exp() to exactly 0.0 in float32 (so padded columns get
+    exact-zero probability AND exact-zero ds in the backward, which is why
+    the backward needs no explicit pad zeroing), and finfo.min + NEG_INF
+    rounds back to finfo.min (ulp at 3.4e38 is ~2e31), preserving the
+    fully-padded-row uniform-softmax behavior documented above.
+    """
     s = jax.lax.dot_general(
         q_blk,
         k_blk,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * scale
-    rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    s = s + jnp.where(cols <= rows, 0.0, NEG_INF)
-    pad = mask_ref[0, :, pl.ds(ki * block_k, block_k)] == 1  # (1, BK)
-    return jnp.where(pad, jnp.finfo(jnp.float32).min, s), pad
+    )
+
+    # causal: global col <= global row  <=>  local c - local r <= (qi-ki)*B
+    # (with square aligned blocks); for strictly-lower blocks the RHS >= B
+    # makes this always-true — one compare+select, no conditionals
+    assert block_q == block_k, "local-iota causal form needs square blocks"
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    s = jnp.where(cols - rows <= (qi - ki) * block_k, s, NEG_INF)
+    if has_mask:
+        s = s + bias_ref[0, :, pl.ds(ki * block_k, block_k)]  # (1, BK) f32
+    return s
 
 
 # ---------------------------------------------------------------------------
@@ -100,7 +144,7 @@ def _masked_scores(q_blk, k_blk, mask_ref, scale, qi, ki, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, block_q, block_k, num_k):
+def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, block_q, block_k, num_k, has_mask):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -114,7 +158,7 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc
         q_blk = q_ref[0]
         k_blk = k_ref[0]
         v_blk = v_ref[0]
-        s, _ = _masked_scores(q_blk, k_blk, mask_ref, scale, qi, ki, block_q, block_k)
+        s = _masked_scores(q_blk, k_blk, mask_ref, qi, ki, block_q, block_k, has_mask)
 
         m_prev = m_scr[:, :1]  # (BQ, 1)
         l_prev = l_scr[:, :1]
@@ -138,15 +182,15 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc
         lse_ref[0, pl.ds(qi * block_q, block_q), :] = m_scr[:, :1] + jnp.log(l)
 
 
-def _flash_forward(q3, k3, v3, mask2, scale, heads):
-    """q3/k3/v3: [BH, S_pad, d]; mask2: [B, 1, S_pad] int32.
-    Returns (out [BH, S_pad, d], lse [BH, S_pad, 1])."""
+def _flash_forward(q3, k3, v3, bias2, heads, has_mask):
+    """q3 (PRESCALED)/k3/v3: [BH, S_pad, d]; bias2: [B, 1, S_pad] f32
+    additive pad bias. Returns (out [BH, S_pad, d], lse [BH, S_pad, 1])."""
     bh, seq_pad, head_dim = q3.shape
     block_q = block_k = min(_BLOCK, seq_pad) if seq_pad >= _LANES else seq_pad
     num_q, num_k = seq_pad // block_q, seq_pad // block_k
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, num_k=num_k
+        _fwd_kernel, block_q=block_q, block_k=block_k, num_k=num_k, has_mask=has_mask
     )
     # K/V fetches for causally-skipped blocks are clamped to the diagonal.
     kv_index = lambda b, qi, ki: (b, jnp.minimum(qi, ki), 0)
@@ -172,8 +216,9 @@ def _flash_forward(q3, k3, v3, mask2, scale, heads):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, head_dim), jnp.float32),
         ],
+        compiler_params=tpu_compiler_params("parallel", "arbitrary", "arbitrary"),
         interpret=_interpret(),
-    )(mask2, q3, k3, v3)
+    )(bias2, q3, k3, v3)
 
 
 # ---------------------------------------------------------------------------
@@ -181,42 +226,22 @@ def _flash_forward(q3, k3, v3, mask2, scale, heads):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref, dq_scr, *, scale, block_q, block_k, num_k):
-    qi, ki = pl.program_id(1), pl.program_id(2)
+def _bwd_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block_q, block_k, num_q, has_mask):
+    """Fused backward: ONE score/probability recomputation per (ki, qi)
+    block pair yields dv and dk (accumulated in VMEM scratch over the inner
+    qi sweep) AND this pair's dq contribution. dq needs accumulation across
+    the OUTER ki axis, which VMEM scratch cannot provide (output blocks may
+    only be revisited in consecutive grid steps), so per-ki partials go to
+    a [num_k]-extended output that XLA reduces afterwards — trading a tiny
+    HBM write for recomputing scores a second time (the previous dq/dkv
+    split did exactly double score work).
 
-    @pl.when(ki == 0)
-    def _():
-        dq_scr[:] = jnp.zeros_like(dq_scr)
-
-    @pl.when(ki <= qi)
-    def _():
-        q_blk, k_blk, v_blk = q_ref[0], k_ref[0], v_ref[0]
-        do_blk = do_ref[0].astype(jnp.float32)
-        s, pad = _masked_scores(q_blk, k_blk, mask_ref, scale, qi, ki, block_q, block_k)
-        lse_col = lse_ref[0, pl.ds(qi * block_q, block_q), :]  # (BQ, 1)
-        dcap_col = dcap_ref[0, pl.ds(qi * block_q, block_q), :]
-        p = jnp.exp(s - lse_col)
-        dp = jax.lax.dot_general(
-            do_blk,
-            v_blk.astype(jnp.float32),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - dcap_col)
-        ds = jnp.where(pad, 0.0, ds)  # the where() in the fwd blocks grads
-        dq_scr[:] += scale * jax.lax.dot_general(
-            ds.astype(k_blk.dtype),
-            k_blk,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-
-    @pl.when(ki == num_k - 1)
-    def _():
-        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
-
-
-def _dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block_q, block_k, num_q):
+    Note q arrives PRESCALED by `scale` (see _masked_scores): dk = ds'q
+    needs no scale factor (q carries it), while dq = ds'k is a gradient
+    w.r.t. the ORIGINAL q, so the chain rule through q*scale applies scale
+    once here. Padded columns need no explicit zeroing: their probability
+    is exp(finfo.min - lse) == 0.0 exactly, so ds is already zero there.
+    """
     ki, qi = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -228,7 +253,7 @@ def _dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dk_ref
     def _():
         q_blk, k_blk, v_blk = q_ref[0], k_ref[0], v_ref[0]
         do_blk = do_ref[0].astype(jnp.float32)
-        s, pad = _masked_scores(q_blk, k_blk, mask_ref, scale, qi, ki, block_q, block_k)
+        s = _masked_scores(q_blk, k_blk, mask_ref, qi, ki, block_q, block_k, has_mask)
         lse_col = lse_ref[0, pl.ds(qi * block_q, block_q), :]  # (BQ, 1)
         dcap_col = dcap_ref[0, pl.ds(qi * block_q, block_q), :]
         p = jnp.exp(s - lse_col)
@@ -245,8 +270,111 @@ def _dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dk_ref
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - dcap_col)
-        ds = jnp.where(pad, 0.0, ds)
-        dk_scr[:] += scale * jax.lax.dot_general(
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q_blk.dtype),
+            q_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dq partials stay f32 until the cross-block sum: rounding each
+        # partial to bf16 first would give SHORT sequences worse dq
+        # precision than the split path's single-rounding scratch
+        dqp_ref[0, 0] = scale * jax.lax.dot_general(
+            ds.astype(k_blk.dtype),
+            k_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi < ki)
+    def _():
+        dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+    @pl.when(qi == num_q - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# Fused-backward ceiling: the dq-partials buffer is num_k x |q| bytes, so
+# past this many k blocks (4k tokens at _BLOCK=1024) the quadratic partials
+# would dwarf q itself and the split two-kernel backward — double score
+# recompute, zero extra HBM — wins. 4 keeps the S<=4k training regime on
+# the fast path.
+_DQ_FUSED_MAX_NUM_K = 4
+
+
+def _dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref, dq_scr, *, scale, block_q, block_k, num_k, has_mask):
+    """Long-sequence dq: grid (bh, num_q, num_k) with ki INNER, so dq
+    accumulates in VMEM scratch — no [num_k]-extended partials (see
+    _flash_backward's size gate). Scores are recomputed a second time
+    relative to the fused kernel; at num_k > _DQ_FUSED_MAX_NUM_K the saved
+    HBM traffic pays for it."""
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(ki <= qi)
+    def _():
+        q_blk, k_blk, v_blk = q_ref[0], k_ref[0], v_ref[0]
+        do_blk = do_ref[0].astype(jnp.float32)
+        s = _masked_scores(q_blk, k_blk, mask_ref, qi, ki, block_q, block_k, has_mask)
+        lse_col = lse_ref[0, pl.ds(qi * block_q, block_q), :]  # (BQ, 1)
+        dcap_col = dcap_ref[0, pl.ds(qi * block_q, block_q), :]
+        p = jnp.exp(s - lse_col)
+        dp = jax.lax.dot_general(
+            do_blk,
+            v_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dcap_col)
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds.astype(k_blk.dtype),
+            k_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == num_k - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, block_q, block_k, num_q, has_mask):
+    """Long-sequence dk/dv: the fused kernel minus the dq-partials output
+    (same scratch accumulation over the inner qi sweep)."""
+    ki, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(qi >= ki)
+    def _():
+        q_blk, k_blk, v_blk = q_ref[0], k_ref[0], v_ref[0]
+        do_blk = do_ref[0].astype(jnp.float32)
+        s = _masked_scores(q_blk, k_blk, mask_ref, qi, ki, block_q, block_k, has_mask)
+        lse_col = lse_ref[0, pl.ds(qi * block_q, block_q), :]
+        dcap_col = dcap_ref[0, pl.ds(qi * block_q, block_q), :]
+        p = jnp.exp(s - lse_col)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do_blk.dtype),
+            do_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk,
+            v_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dcap_col)
+        dk_scr[:] += jax.lax.dot_general(
             ds.astype(q_blk.dtype),
             q_blk,
             dimension_numbers=(((0,), (0,)), ((), ())),
@@ -259,19 +387,22 @@ def _dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dk_ref
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q3, k3, v3, mask2, out, lse, do3, scale, heads):
+def _flash_backward_split(q3, k3, v3, bias2, lse, do3, dcap, scale, heads, has_mask, block_q, block_k):
+    """Two-kernel backward for long sequences: no dq partials in HBM (the
+    fused path's num_k x |q| buffer is S^2-scaled), at the cost of one
+    extra score recompute per block pair."""
     bh, seq_pad, head_dim = q3.shape
-    block_q = block_k = min(_BLOCK, seq_pad) if seq_pad >= _LANES else seq_pad
     num_q, num_k = seq_pad // block_q, seq_pad // block_k
-
-    # D_i = rowsum(dO * O) — cheap, computed outside the kernels.
-    dcap = jnp.sum(do3.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
 
     mask_spec = pl.BlockSpec((1, 1, seq_pad), lambda b, i, j: (b // heads, 0, 0), memory_space=pltpu.VMEM)
     col_spec = pl.BlockSpec((1, seq_pad, 1), lambda b, i, j: (b, 0, 0), memory_space=pltpu.VMEM)
+    cparams = tpu_compiler_params("parallel", "arbitrary", "arbitrary")
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, block_q=block_q, block_k=block_k, num_k=num_k),
+        functools.partial(
+            _dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            num_k=num_k, has_mask=has_mask,
+        ),
         grid=(bh, num_q, num_k),
         in_specs=[
             mask_spec,
@@ -285,11 +416,15 @@ def _flash_backward(q3, k3, v3, mask2, out, lse, do3, scale, heads):
         out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        compiler_params=cparams,
         interpret=_interpret(),
-    )(mask2, q3, k3, v3, do3, lse, dcap)
+    )(bias2, q3, k3, v3, do3, lse, dcap)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k, num_q=num_q),
+        functools.partial(
+            _dkv_kernel, block_q=block_q, block_k=block_k, num_q=num_q,
+            has_mask=has_mask,
+        ),
         grid=(bh, num_k, num_q),
         in_specs=[
             mask_spec,
@@ -312,9 +447,69 @@ def _flash_backward(q3, k3, v3, mask2, out, lse, do3, scale, heads):
             pltpu.VMEM((block_k, head_dim), jnp.float32),
             pltpu.VMEM((block_k, head_dim), jnp.float32),
         ],
+        compiler_params=cparams,
         interpret=_interpret(),
-    )(mask2, q3, k3, v3, do3, lse, dcap)
+    )(bias2, q3, k3, v3, do3, lse, dcap)
 
+    return dq, dk, dv
+
+
+def _flash_backward(q3, k3, v3, bias2, out, lse, do3, scale, heads, has_mask):
+    """q3 arrives PRESCALED. One fused kernel (see _bwd_kernel) produces
+    dk/dv plus per-ki dq partials; the [num_k] partial axis is summed here
+    (a cheap XLA reduction over 2-4 slices at practical block sizes).
+    Past _DQ_FUSED_MAX_NUM_K k-blocks the partials would scale as S^2/block
+    — the split backward takes over (no extra HBM, double score work)."""
+    bh, seq_pad, head_dim = q3.shape
+    block_q = block_k = min(_BLOCK, seq_pad) if seq_pad >= _LANES else seq_pad
+    num_q, num_k = seq_pad // block_q, seq_pad // block_k
+
+    # D_i = rowsum(dO * O) — cheap, computed outside the kernels.
+    dcap = jnp.sum(do3.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
+
+    if num_k > _DQ_FUSED_MAX_NUM_K:
+        return _flash_backward_split(
+            q3, k3, v3, bias2, lse, do3, dcap, scale, heads, has_mask,
+            block_q, block_k,
+        )
+
+    mask_spec = pl.BlockSpec((1, 1, seq_pad), lambda b, i, j: (b // heads, 0, 0), memory_space=pltpu.VMEM)
+    col_spec = pl.BlockSpec((1, seq_pad, 1), lambda b, i, j: (b, 0, 0), memory_space=pltpu.VMEM)
+
+    dq_part, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            num_q=num_q, has_mask=has_mask,
+        ),
+        grid=(bh, num_k, num_q),
+        in_specs=[
+            mask_spec,
+            pl.BlockSpec((1, block_q, head_dim), lambda b, ki, qi: (b, jnp.maximum(qi, ki), 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, ki, qi: (b, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, ki, qi: (b, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, ki, qi: (b, jnp.maximum(qi, ki), 0), memory_space=pltpu.VMEM),
+            col_spec,
+            col_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, ki, qi: (b, ki, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, ki, qi: (b, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, ki, qi: (b, ki, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, num_k, seq_pad, head_dim), jnp.float32),
+            jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+            jax.ShapeDtypeStruct(v3.shape, v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        compiler_params=tpu_compiler_params("parallel", "arbitrary", "arbitrary"),
+        interpret=_interpret(),
+    )(bias2, q3, k3, v3, do3, lse, dcap)
+
+    dq = jnp.sum(dq_part, axis=1).astype(q3.dtype)
     return dq, dk, dv
 
 
@@ -329,9 +524,17 @@ def _flash_backward(q3, k3, v3, mask2, out, lse, do3, scale, heads):
 # ---------------------------------------------------------------------------
 
 
-def _fwd4_impl(q, k, v, mask, scale, heads):
-    """q/k/v: [B, h, S, d]; mask: [B, S] int32 (1 = padding).
-    Returns (out [B, h, S, d], lse [B, h, S, 1])."""
+def _pad_bias(mask, seq_pad):
+    """[B, S] int (1 = padding) -> [B, 1, S_pad] f32 additive bias row."""
+    bias = jnp.where(
+        mask != 0, jnp.finfo(jnp.float32).min, 0.0
+    ).astype(jnp.float32)
+    return jnp.pad(bias, ((0, 0), (0, seq_pad - mask.shape[1])))[:, None, :]
+
+
+def _fwd4_impl(q, k, v, mask, scale, heads, has_mask):
+    """q/k/v: [B, h, S, d]; mask: [B, S] int32 (1 = padding; ignored when
+    has_mask is False). Returns (out [B, h, S, d], lse [B, h, S, 1])."""
     batch, h, seq, head_dim = q.shape
     _, seq_pad = _plan(seq)
 
@@ -339,15 +542,17 @@ def _fwd4_impl(q, k, v, mask, scale, heads):
         t = t.reshape(batch * h, seq, head_dim)
         return jnp.pad(t, ((0, 0), (0, seq_pad - seq), (0, 0)))
 
-    mask2 = jnp.pad(mask, ((0, 0), (0, seq_pad - seq)))[:, None, :]
-    out, lse = _flash_forward(prep(q), prep(k), prep(v), mask2, scale, h)
+    bias2 = _pad_bias(mask, seq_pad)
+    # scale folded into q: one cheap [B,h,S,d] multiply (usually fused into
+    # the producing matmul) replaces a full [BQ,BK] pass per score block
+    out, lse = _flash_forward(prep(q * scale), prep(k), prep(v), bias2, h, has_mask)
     return (
         out[:, :seq].reshape(batch, h, seq, head_dim),
         lse[:, :seq].reshape(batch, h, seq, 1),
     )
 
 
-def _bwd4_impl(q, k, v, mask, out, lse, do, scale, heads):
+def _bwd4_impl(q, k, v, mask, out, lse, do, scale, heads, has_mask):
     batch, h, seq, head_dim = q.shape
     _, seq_pad = _plan(seq)
 
@@ -355,14 +560,15 @@ def _bwd4_impl(q, k, v, mask, out, lse, do, scale, heads):
         t = t.reshape(batch * h, seq, head_dim)
         return jnp.pad(t, ((0, 0), (0, seq_pad - seq), (0, 0)))
 
-    mask2 = jnp.pad(mask, ((0, 0), (0, seq_pad - seq)))[:, None, :]
+    bias2 = _pad_bias(mask, seq_pad)
     # padded lse rows must stay out of exp(): -inf would NaN; any finite
     # value is unused because padded query rows are sliced off below
     lse3 = jnp.pad(
         lse.reshape(batch * h, seq, 1), ((0, 0), (0, seq_pad - seq), (0, 0))
     )
     dq, dk, dv = _flash_backward(
-        prep(q), prep(k), prep(v), mask2, prep(out), lse3, prep(do), scale, h
+        prep(q * scale), prep(k), prep(v), bias2, prep(out), lse3, prep(do),
+        scale, h, has_mask,
     )
 
     def unprep(t):
@@ -418,7 +624,7 @@ def _make_partition(impl, n_out):
         lse_spec = P(spec[0], spec[1], None, None)
         return spec, mask_spec, lse_spec
 
-    def partition(scale, heads, mesh, arg_infos, result_infos):
+    def partition(scale, heads, has_mask, mesh, arg_infos, result_infos):
         from jax.sharding import NamedSharding
 
         spec, mask_spec, lse_spec = specs(mesh, arg_infos)
@@ -430,11 +636,11 @@ def _make_partition(impl, n_out):
         out_sh = tuple(NamedSharding(mesh, s) for s in outs)
 
         def lower(*operands):
-            return impl(*operands, scale, heads)
+            return impl(*operands, scale, heads, has_mask)
 
         return mesh, lower, out_sh, arg_sh
 
-    def infer(scale, heads, mesh, arg_infos, result_infos):
+    def infer(scale, heads, has_mask, mesh, arg_infos, result_infos):
         from jax.sharding import NamedSharding
 
         spec, _, lse_spec = specs(mesh, arg_infos)
@@ -444,7 +650,7 @@ def _make_partition(impl, n_out):
     return partition, infer
 
 
-_fwd4 = custom_partitioning(_fwd4_impl, static_argnums=(4, 5))
+_fwd4 = custom_partitioning(_fwd4_impl, static_argnums=(4, 5, 6))
 _fwd4_partition, _fwd4_infer = _make_partition(_fwd4_impl, 2)
 _fwd4.def_partition(
     partition=_fwd4_partition,
@@ -453,7 +659,7 @@ _fwd4.def_partition(
     sharding_rule="b h s d, b h s d, b h s d, b s -> b h s d, b h s z",
 )
 
-_bwd4 = custom_partitioning(_bwd4_impl, static_argnums=(7, 8))
+_bwd4 = custom_partitioning(_bwd4_impl, static_argnums=(7, 8, 9))
 _bwd4_partition, _bwd4_infer = _make_partition(_bwd4_impl, 3)
 _bwd4.def_partition(
     partition=_bwd4_partition,
@@ -472,20 +678,20 @@ _bwd4.def_partition(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, mask, scale, heads):
-    out, _ = _fwd4(q, k, v, mask, scale, heads)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, mask, scale, heads, has_mask):
+    out, _ = _fwd4(q, k, v, mask, scale, heads, has_mask)
     return out
 
 
-def _flash_fwd(q, k, v, mask, scale, heads):
-    out, lse = _fwd4(q, k, v, mask, scale, heads)
+def _flash_fwd(q, k, v, mask, scale, heads, has_mask):
+    out, lse = _fwd4(q, k, v, mask, scale, heads, has_mask)
     return out, (q, k, v, mask, out, lse)
 
 
-def _flash_bwd(scale, heads, residuals, g):
+def _flash_bwd(scale, heads, has_mask, residuals, g):
     q, k, v, mask, out, lse = residuals
-    dq, dk, dv = _bwd4(q, k, v, mask, out, lse, g, scale, heads)
+    dq, dk, dv = _bwd4(q, k, v, mask, out, lse, g, scale, heads, has_mask)
     dmask = np.zeros(mask.shape, dtype=jax.dtypes.float0)
     return dq, dk, dv, dmask
 
@@ -505,7 +711,9 @@ def flash_causal_attention(q, k, v, *, scale, pad_mask=None):
     """
     batch, heads, seq, head_dim = q.shape
     if pad_mask is None:
+        # has_mask=False compiles the pad-bias pass out of the kernels; the
+        # dummy mask still rides along so the operand list (and its GSPMD
+        # partitioning rule) is identical in both modes
         mask = jnp.zeros((batch, seq), jnp.int32)
-    else:
-        mask = pad_mask.astype(jnp.int32)
-    return _flash(q, k, v, mask, scale, heads)
+        return _flash(q, k, v, mask, scale, heads, False)
+    return _flash(q, k, v, pad_mask.astype(jnp.int32), scale, heads, True)

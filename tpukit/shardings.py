@@ -51,6 +51,10 @@ class Strategy:
     main-single.py:21,33 — here, a trivial 1-device mesh)."""
 
     name = "single"
+    # Compute the loss through the fused head+CE kernel (no [B*S, V] logits
+    # buffer — ops/fused_head_ce.py). TensorParallel turns this off: its
+    # vocab-sharded head wants the GSPMD matmul path.
+    fused_head = True
 
     def __init__(self, mesh: Mesh | None = None):
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh(None)
@@ -114,7 +118,28 @@ class Strategy:
         path). Under GSPMD the global mask is generated once and sharded
         (threefry is partitionable), so dropout is consistent across DP/FSDP
         shards — the twin of torch dropout running under DDP.
+
+        The head + cross-entropy run through the fused Pallas kernel
+        (ops/fused_head_ce.py) unless the strategy opts out: no logits
+        buffer in HBM, which is both the long-context perf win and what
+        lets batch sizes the unfused logits tensor would OOM.
         """
+        if self.fused_head:
+            from tpukit.ops.fused_head_ce import fused_head_ce
+
+            h = gpt.forward_hidden(
+                params, cfg, batch["input_ids"], batch["position_ids"],
+                batch["mask"], rng=rng, deterministic=rng is None,
+            )
+            loss_sum, count, correct = fused_head_ce(
+                h.reshape(-1, h.shape[-1]),
+                params["lm_head"]["kernel"],
+                targets.reshape(-1),
+                cfg.vocab_size,
+                with_accuracy=with_accuracy,
+            )
+            denom = jnp.maximum(count, 1.0)
+            return loss_sum / denom, correct / denom * 100.0
         logits = gpt.forward(
             params, cfg, batch["input_ids"], batch["position_ids"], batch["mask"],
             rng=rng, deterministic=rng is None,
@@ -334,6 +359,7 @@ class TensorParallel(Strategy):
     """
 
     name = "tp"
+    fused_head = False  # the vocab-sharded head wants the GSPMD matmul path
 
     def __init__(self, mesh: Mesh | None = None):
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"model": -1})

@@ -1,0 +1,113 @@
+"""On-device sweep of the long-context (S=2048) train-step throughput over
+the Pallas tile knobs and batch size. Run on the real TPU:
+
+    python tools/sweep_long_context.py [--quick]
+
+Prints one line per configuration (tok/s/chip, best-of-3 windows) and a
+final ranking. Knobs swept via env are read at import time by the kernels,
+so each config runs in a subprocess.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+CHILD = r"""
+import time, sys, json
+import jax, jax.numpy as jnp
+from tpukit.model import GPTConfig
+from tpukit.train import create_train_state, make_optimizer, make_step_fns
+import tpukit.shardings as sh
+
+batch = int(sys.argv[1])
+seq = 2048
+cfg = GPTConfig(
+    dim=256, head_dim=32, heads=8, num_layers=8, vocab_size=50257,
+    max_position_embeddings=seq, compute_dtype=jnp.bfloat16,
+)
+strategy = sh.SingleDevice()
+optimizer = make_optimizer(1e-4)
+state = create_train_state(jax.random.PRNGKey(0), cfg, optimizer)
+shapes = jax.eval_shape(lambda: state)
+train_step, _, state_sharding = make_step_fns(cfg, optimizer, strategy, shapes)
+state = jax.device_put(state, state_sharding)
+ids = jnp.zeros((batch, seq - 1), jnp.int32)
+model_batch = {
+    "input_ids": ids,
+    "position_ids": jnp.broadcast_to(jnp.arange(seq - 1, dtype=jnp.int32), ids.shape),
+    "mask": jnp.zeros(ids.shape, bool),
+}
+targets = jnp.zeros(ids.shape, jnp.int32)
+for _ in range(3):
+    state, loss = train_step(state, model_batch, targets)
+float(loss)
+best = float("inf")
+for _ in range(3):
+    t0 = time.perf_counter()
+    for _ in range(6):
+        state, loss = train_step(state, model_batch, targets)
+    float(loss)
+    best = min(best, time.perf_counter() - t0)
+print(json.dumps({"tps": 6 * batch * seq / best}))
+"""
+
+
+def run(env_extra: dict, batch: int) -> float | None:
+    env = dict(os.environ, **{k: str(v) for k, v in env_extra.items()})
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", CHILD, str(batch)],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        line = out.stdout.strip().splitlines()[-1]
+        return json.loads(line)["tps"]
+    except Exception as exc:  # OOM / compile failure: report and move on
+        tail = (out.stderr if "out" in dir() else "")[-300:]
+        print(f"  failed: {exc!r} {tail}", file=sys.stderr)
+        return None
+
+
+def main():
+    ints = lambda s: tuple(int(x) for x in s.split(","))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="fewer configs")
+    ap.add_argument("--flash", type=ints, default=None)
+    ap.add_argument("--tblk", type=ints, default=None)
+    ap.add_argument("--vblk", type=ints, default=None)
+    ap.add_argument("--batch", type=ints, default=None)
+    args = ap.parse_args()
+
+    configs = []
+    for fb in args.flash or ((1024, 2048) if args.quick else (512, 1024, 2048)):
+        for tb in args.tblk or ((1024,) if args.quick else (512, 1024, 2048)):
+            for vb in args.vblk or ((2048,) if args.quick else (1024, 2048, 4096)):
+                for batch in args.batch or ((16,) if args.quick else (16, 24, 32)):
+                    configs.append({
+                        "TPUKIT_FLASH_BLOCK": fb,
+                        "TPUKIT_CE_T_BLOCK": tb,
+                        "TPUKIT_CE_V_BLOCK": vb,
+                        "_batch": batch,
+                    })
+
+    results = []
+    for c in configs:
+        batch = c.pop("_batch")
+        tps = run(c, batch)
+        tag = f"flash={c['TPUKIT_FLASH_BLOCK']} t={c['TPUKIT_CE_T_BLOCK']} v={c['TPUKIT_CE_V_BLOCK']} b={batch}"
+        print(f"{tag}: {tps and round(tps):,}".replace(",", "_") if tps else f"{tag}: FAIL", flush=True)
+        if tps:
+            results.append((tps, tag))
+
+    results.sort(reverse=True)
+    print("\ntop 5:")
+    for tps, tag in results[:5]:
+        print(f"  {round(tps):>9,} tok/s/chip  {tag}")
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,7 @@ test mesh exercises the exact kernel code path).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -39,8 +40,13 @@ from tpukit.ops.pallas_attention import _interpret, tpu_compiler_params
 
 NEG_INF = -1e9  # same pad-column clamp as apply_head (model/gpt.py)
 
-_T_BLK = 1024  # token-tile rows
-_V_BLK = 2048  # vocab-tile columns
+# Tile edges, env-sweepable like TPUKIT_FLASH_BLOCK. t=2048/v=2048 measured
+# fastest at the S=2048 bench shape on v5e (tools/sweep_long_context.py;
+# the sweep is near-flat +-4%, so these are not load-bearing). Values are
+# rounded up to the hardware tile multiples (8 sublanes / 128 lanes) so a
+# misaligned sweep value cannot die in Mosaic lowering.
+_T_BLK = -(-max(8, int(os.environ.get("TPUKIT_CE_T_BLOCK", "2048"))) // 8) * 8
+_V_BLK = -(-max(128, int(os.environ.get("TPUKIT_CE_V_BLOCK", "2048"))) // 128) * 128
 
 
 def _pads(n_tokens: int, v_pad: int) -> tuple[int, int, int, int]:

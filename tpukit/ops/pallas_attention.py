@@ -51,8 +51,11 @@ NEG_INF = -1e9  # causal additive term (twin of models/gpt.py:83)
 
 _LANES = 128
 # Score-block edge. Bigger blocks amortize grid overhead at long sequence
-# lengths; sweepable via env for tuning.
-_BLOCK = max(_LANES, int(os.environ.get("TPUKIT_FLASH_BLOCK", "1024")))
+# lengths; sweepable via env. 2048 measured fastest at S=2048 on v5e
+# (tools/sweep_long_context.py: +3.5% over 1024 — grid overhead outweighs
+# the causal-skip savings smaller blocks enable); the [2048,2048] f32 score
+# block is 16MB, comfortably inside the 100MB VMEM budget.
+_BLOCK = max(_LANES, int(os.environ.get("TPUKIT_FLASH_BLOCK", "2048")))
 
 
 def on_tpu_backend() -> bool:
@@ -296,12 +299,17 @@ def _bwd_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dqp_re
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-# Fused-backward ceiling: the dq-partials buffer is num_k x |q| bytes, so
-# past this many k blocks (4k tokens at _BLOCK=1024) the quadratic partials
-# would dwarf q itself and the split two-kernel backward — double score
-# recompute, zero extra HBM — wins. 4 keeps the S<=4k training regime on
-# the fast path.
+# Fused-backward gates. The fused kernel writes an f32 dq-partials buffer
+# of bh x num_k x S_pad x d (= 2*num_k times the bf16 q tensor) — measured
+# ~13% faster than the split backward at S=8192/b=4 on v5e, but its size
+# scales as S^2/block, so it is gated BOTH on a k-block cap and on the
+# buffer's actual bytes (batch-aware): past either limit the split
+# two-kernel backward — double score recompute, zero extra HBM — takes
+# over. Sweepable: TPUKIT_FLASH_DQ_PARTIALS_MB.
 _DQ_FUSED_MAX_NUM_K = 4
+_DQ_PARTIALS_BUDGET = (
+    int(os.environ.get("TPUKIT_FLASH_DQ_PARTIALS_MB", "256")) * 1024 * 1024
+)
 
 
 def _dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref, dq_scr, *, scale, block_q, block_k, num_k, has_mask):
@@ -467,7 +475,8 @@ def _flash_backward(q3, k3, v3, bias2, out, lse, do3, scale, heads, has_mask):
     # D_i = rowsum(dO * O) — cheap, computed outside the kernels.
     dcap = jnp.sum(do3.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
 
-    if num_k > _DQ_FUSED_MAX_NUM_K:
+    dq_partials_bytes = bh * num_k * seq_pad * head_dim * 4
+    if num_k > _DQ_FUSED_MAX_NUM_K or dq_partials_bytes > _DQ_PARTIALS_BUDGET:
         return _flash_backward_split(
             q3, k3, v3, bias2, lse, do3, dcap, scale, heads, has_mask,
             block_q, block_k,

@@ -314,17 +314,36 @@ class ContextParallel(Strategy):
                 params["layers"], local_cfg, x, mask,
                 rng=local_rng, deterministic=local_rng is None,
             )
-            # custom-VJP sum: no f32 [B, S, V] tensor in either direction
-            # (tpukit/ops/layers.py cross_entropy_sum)
-            logits = gpt.apply_head(params, local_cfg, x)
-            loss_sum, count = cross_entropy_sum(logits, tgts)
-            if with_accuracy:
-                valid = tgts != -100
-                correct = jnp.sum(
-                    jnp.where(valid, jnp.argmax(logits, axis=-1) == tgts, False)
-                ).astype(jnp.float32)
+            if self.fused_head:
+                # Each shard's tokens through the fused head+CE kernel
+                # (composes under shard_map Manual like the flash kernel):
+                # no [B, S_local, V] logits tensor even per shard — CP is
+                # the long-context strategy, where that buffer hurts most.
+                from tpukit.ops.fused_head_ce import fused_head_ce
+                from tpukit.ops.layers import layer_norm
+
+                h = layer_norm(x, params["norm_out"]).astype(
+                    local_cfg.compute_dtype
+                )
+                loss_sum, count, correct = fused_head_ce(
+                    h.reshape(-1, h.shape[-1]),
+                    params["lm_head"]["kernel"],
+                    tgts.reshape(-1),
+                    cfg.vocab_size,
+                    with_accuracy=with_accuracy,
+                )
             else:
-                correct = jnp.float32(0)
+                # custom-VJP sum: no f32 [B, S, V] tensor in either
+                # direction (tpukit/ops/layers.py cross_entropy_sum)
+                logits = gpt.apply_head(params, local_cfg, x)
+                loss_sum, count = cross_entropy_sum(logits, tgts)
+                if with_accuracy:
+                    valid = tgts != -100
+                    correct = jnp.sum(
+                        jnp.where(valid, jnp.argmax(logits, axis=-1) == tgts, False)
+                    ).astype(jnp.float32)
+                else:
+                    correct = jnp.float32(0)
             return (
                 jax.lax.psum(loss_sum, axes),
                 jax.lax.psum(count, axes),

@@ -157,6 +157,18 @@ def test_multiblock_fused_and_split_backward(monkeypatch):
             err_msg=f"split d{name} mismatch",
         )
 
+    # the byte-budget gate alone must also route to the split path (and
+    # still match): a large-batch long-sequence config whose dq-partials
+    # exceed TPUKIT_FLASH_DQ_PARTIALS_MB never allocates them
+    monkeypatch.setattr(pa, "_DQ_FUSED_MAX_NUM_K", 3)
+    monkeypatch.setattr(pa, "_DQ_PARTIALS_BUDGET", 1)  # bytes
+    g_budget = jax.grad(loss(flash_causal_attention), argnums=(0, 1, 2))(q, k, v)
+    for ours, ref, name in zip(g_budget, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(ours), np.asarray(ref), atol=5e-4, rtol=1e-3,
+            err_msg=f"budget-gated d{name} mismatch",
+        )
+
 
 def test_auto_dispatch_gspmd_safe():
     """Under GSPMD-sharded jit on a multi-device mesh, impl='auto' is

@@ -151,6 +151,54 @@ def test_token_sharded_grads_match_unsharded(setup):
     np.testing.assert_allclose(np.asarray(sh_g[1]), np.asarray(ref_g[1]), atol=1e-6)
 
 
+def test_overfit_multi_tile_vocab():
+    """End-to-end semantic guard: a 2-layer model must overfit one repeated
+    batch at a MULTI-TILE vocab (here forced via a small _V_BLK). An
+    indexing bug anywhere in the fused loss (e.g. a tile-relative target
+    select) leaves the loss near log(vocab) and fails this, even when
+    per-op equivalence tests are green."""
+    import tpukit.ops.fused_head_ce as m
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    orig = m._V_BLK
+    m._V_BLK = 128  # vocab 300 -> 3 tiles
+    try:
+        cfg = GPTConfig(
+            dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=300,
+            max_position_embeddings=32, compute_dtype=jnp.float32,
+        )
+        strategy = SingleDevice()
+        assert strategy.fused_head
+        optimizer = make_optimizer(3e-3)
+        state = create_train_state(jax.random.PRNGKey(0), cfg, optimizer)
+        shapes = jax.eval_shape(lambda: state)
+        step, _, sh = make_step_fns(cfg, optimizer, strategy, shapes)
+        state = jax.device_put(state, sh)
+
+        r = np.random.RandomState(0)
+        ids = jnp.asarray(r.randint(130, 300, (4, 32)).astype(np.int32))
+        batch = {
+            "input_ids": ids,
+            "position_ids": jnp.broadcast_to(
+                jnp.arange(32, dtype=jnp.int32), (4, 32)
+            ),
+            "mask": jnp.zeros((4, 32), bool),
+        }
+        tgt = jnp.asarray(r.randint(130, 300, (4, 32)).astype(np.int32))
+        first = None
+        for _ in range(60):
+            state, loss = step(state, batch, tgt)
+            if first is None:
+                first = float(loss)
+        # random-chance loss is log(300) ~ 5.7; memorizing one batch must
+        # cut it far below that
+        assert first > 5.0
+        assert float(loss) < 2.0, f"loss stuck at {float(loss)} (started {first})"
+    finally:
+        m._V_BLK = orig
+
+
 def test_strategy_loss_fused_matches_unfused_path():
     """The default strategy loss (fused) equals the same computation through
     gpt.forward + cross_entropy_loss (unfused)."""

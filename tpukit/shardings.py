@@ -31,6 +31,8 @@ overrides it with the micro-batched schedule.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -46,6 +48,13 @@ def _sharding_tree(mesh: Mesh, spec_fn, tree_shapes):
     return jax.tree.map(lambda leaf: NamedSharding(mesh, spec_fn(leaf.shape)), tree_shapes)
 
 
+
+def _fused_head_disabled() -> bool:
+    """TPUKIT_FUSED_HEAD=0 routes every strategy back to the unfused XLA
+    head+CE (read at use time so it works however late it is set)."""
+    return os.environ.get("TPUKIT_FUSED_HEAD", "1") == "0"
+
+
 class Strategy:
     """Base: single-device (twin of main-single.py: plain `.to(device)`,
     main-single.py:21,33 — here, a trivial 1-device mesh)."""
@@ -53,7 +62,9 @@ class Strategy:
     name = "single"
     # Compute the loss through the fused head+CE kernel (no [B*S, V] logits
     # buffer — ops/fused_head_ce.py). TensorParallel turns this off: its
-    # vocab-sharded head wants the GSPMD matmul path.
+    # vocab-sharded head wants the GSPMD matmul path. TPUKIT_FUSED_HEAD=0
+    # (checked at use time, never forces the kernel ON) is the operational
+    # escape hatch back to the unfused XLA path.
     fused_head = True
 
     def __init__(self, mesh: Mesh | None = None):
@@ -124,7 +135,7 @@ class Strategy:
         buffer in HBM, which is both the long-context perf win and what
         lets batch sizes the unfused logits tensor would OOM.
         """
-        if self.fused_head:
+        if self.fused_head and not _fused_head_disabled():
             from tpukit.ops.fused_head_ce import fused_head_ce
 
             h = gpt.forward_hidden(
@@ -314,7 +325,7 @@ class ContextParallel(Strategy):
                 params["layers"], local_cfg, x, mask,
                 rng=local_rng, deterministic=local_rng is None,
             )
-            if self.fused_head:
+            if self.fused_head and not _fused_head_disabled():
                 # Each shard's tokens through the fused head+CE kernel
                 # (composes under shard_map Manual like the flash kernel):
                 # no [B, S_local, V] logits tensor even per shard — CP is

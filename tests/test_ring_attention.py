@@ -12,7 +12,7 @@ from jax.sharding import PartitionSpec as P
 from tpukit.mesh import create_mesh
 from tpukit.model import GPTConfig
 from tpukit.ops.attention import causal_attention
-from tpukit.ring_attention import ring_causal_attention
+from tpukit.ring_attention import ring_causal_attention, zigzag_order
 from tpukit.shardings import ContextParallel, SingleDevice
 from tpukit.train import create_train_state, make_optimizer, make_step_fns
 
@@ -73,6 +73,62 @@ def test_ring_grads_match_dense(qkvm):
     g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
     g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for ours, ref, name in zip(g_ring, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(ours), np.asarray(ref), atol=1e-4, rtol=1e-3,
+            err_msg=f"d{name}",
+        )
+
+
+def _zigzag_on_mesh(q, k, v, mask, seq_shards):
+    """Permute to the zigzag layout, run the balanced ring, unpermute."""
+    order = zigzag_order(S, seq_shards)
+    inv = np.argsort(order)
+    mesh = create_mesh({"seq": seq_shards})
+
+    def local(q, k, v, m):
+        return ring_causal_attention(
+            q, k, v, scale=SCALE, axis_name="seq", pad_mask=m, layout="zigzag"
+        )
+
+    out = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, None, "seq"), P(None, None, "seq"), P(None, None, "seq"), P(None, "seq")),
+        out_specs=P(None, None, "seq"),
+        check_vma=False,
+    )(q[:, :, order], k[:, :, order], v[:, :, order], mask[:, order])
+    return out[:, :, inv]
+
+
+@pytest.mark.parametrize("seq_shards", [2, 4, 8])
+def test_zigzag_matches_dense(qkvm, seq_shards):
+    q, k, v, mask = qkvm
+    ours = _zigzag_on_mesh(q, k, v, mask, seq_shards)
+    ref = causal_attention(q, k, v, scale=SCALE, pad_mask=mask)
+    valid = ~np.asarray(mask)
+    for b in range(B):
+        np.testing.assert_allclose(
+            np.asarray(ours)[b, :, valid[b]],
+            np.asarray(ref)[b, :, valid[b]],
+            atol=1e-5,
+            rtol=1e-4,
+        )
+
+
+def test_zigzag_grads_match_dense(qkvm):
+    q, k, v, mask = qkvm
+
+    def loss_zz(q, k, v):
+        out = _zigzag_on_mesh(q, k, v, mask, 4)
+        return jnp.sum(jnp.where(~mask[:, None, :, None], out, 0.0) ** 2)
+
+    def loss_dense(q, k, v):
+        out = causal_attention(q, k, v, scale=SCALE, pad_mask=mask)
+        return jnp.sum(jnp.where(~mask[:, None, :, None], out, 0.0) ** 2)
+
+    g_zz = jax.grad(loss_zz, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for ours, ref, name in zip(g_zz, g_dense, "qkv"):
         np.testing.assert_allclose(
             np.asarray(ours), np.asarray(ref), atol=1e-4, rtol=1e-3,
             err_msg=f"d{name}",

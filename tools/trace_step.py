@@ -1,0 +1,97 @@
+"""Capture a jax.profiler trace of the S=2048 train step and print the
+top device ops by total duration — the op-level breakdown that drives the
+round-4 MFU work (VERDICT r3 #1).
+
+    python tools/trace_step.py [--seq 2048] [--batch 16] [--top 25]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import os
+import tempfile
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from tpukit.model import GPTConfig
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--top", type=int, default=25)
+    args = ap.parse_args()
+    seq = args.seq - 1
+
+    cfg = GPTConfig(
+        dim=256, head_dim=32, heads=8, num_layers=8, vocab_size=50257,
+        max_position_embeddings=args.seq, compute_dtype=jnp.bfloat16,
+    )
+    optimizer = make_optimizer(1e-4)
+    strategy = SingleDevice()
+    state = create_train_state(jax.random.PRNGKey(0), cfg, optimizer)
+    shapes = jax.eval_shape(lambda: state)
+    step, _, sh = make_step_fns(cfg, optimizer, strategy, shapes)
+    state = jax.device_put(state, sh)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(args.batch, seq)).astype(np.int32)
+    batch = {
+        "input_ids": ids,
+        "position_ids": np.ascontiguousarray(
+            np.broadcast_to(np.arange(seq, dtype=np.int32), ids.shape)
+        ),
+        "mask": np.zeros_like(ids, dtype=bool),
+    }
+    targets = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    for _ in range(3):
+        state, loss = step(state, batch, targets)
+    float(loss)
+
+    tmp = tempfile.mkdtemp(prefix="tpukit_trace_")
+    with jax.profiler.trace(tmp):
+        for _ in range(3):
+            state, loss = step(state, batch, targets)
+        float(loss)
+
+    paths = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
+    if not paths:
+        raise SystemExit(f"no xplane.pb under {tmp}")
+    raw = open(paths[0], "rb").read()
+    data = jax.profiler.ProfileData.from_serialized_xspace(raw)
+
+    import re
+
+    per_op = defaultdict(float)
+    for plane in data.planes:
+        if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+            continue
+        for line in plane.lines:
+            for ev in line.events:
+                name = ev.name
+                # skip wrapper spans and async copy spans (their duration
+                # includes the wait, overlapping real compute)
+                if name.startswith("jit_") or "copy-start" in name or name in ("0", "1", "2", "3"):
+                    continue
+                dur = (ev.end_ns - ev.start_ns) / 1e6
+                # group: collapse %op.123 suffixes and shape strings
+                g = re.split(r"\s*=", name)[0].strip()
+                g = re.sub(r"\.\d+$", "", g)
+                per_op[g] += dur
+    total = sum(per_op.values())
+    print(f"op-sum: {total:.1f} ms over 3 steps ({total/3:.1f}/step)")
+    for name, ms in sorted(per_op.items(), key=lambda kv: -kv[1])[: args.top]:
+        print(f"{ms/3:8.2f} ms/step  {ms/total*100:5.1f}%  {name[:100]}")
+
+
+if __name__ == "__main__":
+    main()

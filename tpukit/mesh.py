@@ -32,12 +32,28 @@ def initialize_runtime() -> None:
     global _initialized
     if _initialized:
         return
+    if _distributed_client_active():
+        _initialized = True  # launcher/runtime already did the rendezvous
+        return
+    # NB: must run BEFORE any backend-initializing JAX call (jax.devices(),
+    # jax.process_count(), ...) — jax.distributed.initialize() refuses to run
+    # after the XLA backend exists. So multi-host detection here is env-only.
     explicit = bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
-    if jax.process_count() > 1 or explicit:
+    if explicit or _pod_env_detected():
         try:
             jax.distributed.initialize()
         except Exception as exc:
-            if "already" in str(exc).lower():
+            msg = str(exc).lower()
+            # Actual JAX error texts for the two benign races:
+            # "distributed.initialize should only be called once" and
+            # "... must be called before any JAX calls that might initialise
+            # the XLA backend" (when the launcher initialized both for us and
+            # a client is now active).
+            if (
+                "only be called once" in msg
+                or "already" in msg
+                or _distributed_client_active()
+            ):
                 pass  # initialized by the launcher/runtime — fine
             elif explicit:
                 # The operator asked for a multi-host run. Silently falling
@@ -58,6 +74,38 @@ def initialize_runtime() -> None:
                     stacklevel=2,
                 )
     _initialized = True
+
+
+def _distributed_client_active() -> bool:
+    """True when `jax.distributed` is already wired up (by us, a launcher,
+    or the TPU runtime) — detected via the distributed client object, not by
+    string-matching error messages."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
+def _pod_env_detected() -> bool:
+    """Env-var-only sniff for a multi-host environment (no JAX calls, so the
+    backend stays uninitialized and `jax.distributed.initialize()` is still
+    legal). Covers Cloud TPU pod slices, megascale, SLURM and OMPI launchers
+    — the environments JAX's own cluster auto-detection understands. Each
+    signal must show MORE THAN ONE host (single-host TPU VMs also export
+    TPU_WORKER_HOSTNAMES, as a one-entry list)."""
+    if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):  # pod slice
+        return True
+    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):  # multislice
+        return True
+    for k in ("SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE"):
+        try:
+            if int(os.environ.get(k, "1")) > 1:
+                return True
+        except ValueError:
+            pass
+    return False
 
 
 def is_process_zero() -> bool:

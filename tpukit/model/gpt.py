@@ -77,6 +77,12 @@ class GPTConfig:
     # over the `ring_axis` mesh axis — only valid inside shard_map.
     attention_impl: str = "auto"  # "auto" | "xla" | "flash" | "ring"
     ring_axis: str = "seq"
+    # Sequence layout of the ring shards: "contiguous" (device d holds rows
+    # [d*Sl, (d+1)*Sl)) or "zigzag" (device d holds chunks d and 2P-1-d of
+    # 2P — causally load-balanced; see tpukit/ring_attention.py). Only
+    # meaningful with attention_impl="ring"; ContextParallel sets it and
+    # permutes the batch to match.
+    ring_layout: str = "contiguous"
     # TPU perf: the embedding table and lm_head are padded so the vocab
     # dimension is a multiple of this (50257 -> 50304, a 128-lane multiple —
     # the dominant matmul of the small-dim reference shape tiles cleanly
@@ -236,6 +242,7 @@ def _apply_attention(layer, cfg: GPTConfig, x, pad_mask, rng, deterministic):
         pad_mask=pad_mask,
         impl=cfg.attention_impl,
         ring_axis=cfg.ring_axis,
+        ring_layout=cfg.ring_layout,
     )
     out = out.transpose(0, 2, 1, 3).reshape(batch, seq_len, cfg.inner_dim)
     out = linear(out, layer["attn"]["out"], cfg.compute_dtype)

@@ -37,6 +37,7 @@ def causal_attention(
     pad_mask: jax.Array | None = None,
     impl: str = "xla",
     ring_axis: str = "seq",
+    ring_layout: str = "contiguous",
 ) -> jax.Array:
     """Scaled dot-product causal attention.
 
@@ -69,7 +70,8 @@ def causal_attention(
         from tpukit.ring_attention import ring_causal_attention
 
         return ring_causal_attention(
-            q, k, v, scale=scale, axis_name=ring_axis, pad_mask=pad_mask
+            q, k, v, scale=scale, axis_name=ring_axis, pad_mask=pad_mask,
+            layout=ring_layout,
         )
 
     seq_len = q.shape[2]

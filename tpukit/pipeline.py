@@ -368,47 +368,64 @@ class Pipeline(Strategy):
                     # accumulates the SAME totals; the final psum over the
                     # stage axis scales numerator and denominator alike, so
                     # the loss/accuracy ratios are exact.
-                    y_last = psum_bcast(
-                        jnp.where(stage == last, y, jnp.zeros_like(y)), "stage"
-                    )
-                    tgt_last = jax.lax.psum(
-                        jnp.where(stage == last, tgt_in, 0), "stage"
-                    )
-                    h = layer_norm(y_last, rest_params["norm_out"]).astype(
-                        cfg.compute_dtype
-                    )
-                    local_logits = linear(
-                        h, {"kernel": rest_params["lm_head"]["kernel"]},
-                        cfg.compute_dtype,
-                    )
-                    offset = stage * v_local
-                    col = offset + jax.lax.broadcasted_iota(jnp.int32, (v_local,), 0)
-                    local_logits = jnp.where(
-                        col < cfg.vocab_size, local_logits,
-                        jnp.asarray(-1e9, local_logits.dtype),
-                    )
-                    # no f32 [micro, S, V] anywhere: each stage holds V/S
-                    # columns and the CE backward is local (vocab_parallel_ce)
-                    l_sum, cnt = vocab_parallel_ce(local_logits, tgt_last, offset, "stage")
-                    if with_accuracy:
-                        lf = local_logits.astype(jnp.float32)
-                        lmax = jnp.max(lf, axis=-1)
-                        larg = jnp.argmax(lf, axis=-1) + offset
-                        gmax = jax.lax.pmax(lmax, "stage")
-                        # global argmax, first-index tie-break like argmax
-                        preds = jax.lax.pmin(
-                            jnp.where(lmax >= gmax, larg, v_pad), "stage"
+                    #
+                    # The whole block — including the activation psum_bcast —
+                    # is gated behind `emit` (VERDICT r3 #7): during the S-1
+                    # warm-up steps no micro-batch has reached the last stage
+                    # yet, so broadcasting + head compute there is pure
+                    # waste (and its backward too). `emit` depends only on t,
+                    # so every device takes the same cond branch and the
+                    # collectives inside stay globally matched.
+                    def head_loss(_):
+                        y_last = psum_bcast(
+                            jnp.where(stage == last, y, jnp.zeros_like(y)),
+                            "stage",
                         )
-                        valid = tgt_last != -100
-                        corr = jnp.sum(
-                            jnp.where(valid, preds == tgt_last, False)
-                        ).astype(jnp.float32)
-                    else:
-                        corr = jnp.float32(0)
+                        tgt_last = jax.lax.psum(
+                            jnp.where(stage == last, tgt_in, 0), "stage"
+                        )
+                        h = layer_norm(y_last, rest_params["norm_out"]).astype(
+                            cfg.compute_dtype
+                        )
+                        local_logits = linear(
+                            h, {"kernel": rest_params["lm_head"]["kernel"]},
+                            cfg.compute_dtype,
+                        )
+                        offset = stage * v_local
+                        col = offset + jax.lax.broadcasted_iota(
+                            jnp.int32, (v_local,), 0
+                        )
+                        local_logits = jnp.where(
+                            col < cfg.vocab_size, local_logits,
+                            jnp.asarray(-1e9, local_logits.dtype),
+                        )
+                        # no f32 [micro, S, V] anywhere: each stage holds V/S
+                        # columns, CE backward is local (vocab_parallel_ce)
+                        l_sum, cnt = vocab_parallel_ce(
+                            local_logits, tgt_last, offset, "stage"
+                        )
+                        if with_accuracy:
+                            lf = local_logits.astype(jnp.float32)
+                            lmax = jnp.max(lf, axis=-1)
+                            larg = jnp.argmax(lf, axis=-1) + offset
+                            gmax = jax.lax.pmax(lmax, "stage")
+                            # global argmax, first-index tie-break like argmax
+                            preds = jax.lax.pmin(
+                                jnp.where(lmax >= gmax, larg, v_pad), "stage"
+                            )
+                            valid = tgt_last != -100
+                            corr = jnp.sum(
+                                jnp.where(valid, preds == tgt_last, False)
+                            ).astype(jnp.float32)
+                        else:
+                            corr = jnp.float32(0)
+                        return l_sum, cnt, corr
+
+                    def no_loss(_):
+                        return jnp.float32(0), jnp.float32(0), jnp.float32(0)
+
                     emit = t >= num_stages - 1  # uniform across stages
-                    l_sum = jnp.where(emit, l_sum, 0.0)
-                    cnt = jnp.where(emit, cnt, 0.0)
-                    corr = jnp.where(emit, corr, 0.0)
+                    l_sum, cnt, corr = jax.lax.cond(emit, head_loss, no_loss, None)
                 else:
 
                     def head_loss(_):

@@ -304,7 +304,23 @@ class ContextParallel(Strategy):
                 f"sequence length {seq_len} must divide over {self.seq_size} "
                 f"sequence shards (pick a dividing --sequence_length)"
             )
-        local_cfg = cfg.replace(attention_impl="ring", ring_axis="seq")
+        # Zigzag layout (causal load balance — tpukit/ring_attention.py):
+        # permute the sequence so each shard holds one early + one late
+        # chunk; every per-token computation (embeddings, MLPs, CE sums) is
+        # permutation-invariant, so only the ring schedule needs to know.
+        # Falls back to the contiguous ring when 2*P doesn't divide S.
+        use_zigzag = seq_len % (2 * self.seq_size) == 0 and self.seq_size > 1
+        if use_zigzag:
+            from tpukit.ring_attention import zigzag_order
+
+            order = zigzag_order(seq_len, self.seq_size)
+            batch = {key: val[:, order] for key, val in batch.items()}
+            targets = targets[:, order]
+        local_cfg = cfg.replace(
+            attention_impl="ring",
+            ring_axis="seq",
+            ring_layout="zigzag" if use_zigzag else "contiguous",
+        )
         batch_spec = self.batch_spec()
         axes = tuple(self.mesh.axis_names)
 

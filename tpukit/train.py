@@ -431,15 +431,29 @@ def fit(
             bar.set_description(
                 f"[validation] Epoch {epoch+1}/{epochs} | loss: ?????, accuracy: ?????"
             )
-            total_loss, total_acc = 0.0, 0.0
+            total_loss, total_acc, total_weight = 0.0, 0.0, 0.0
             eval_metrics = {"loss": float("nan"), "accuracy": float("nan")}
             for i, raw in enumerate(bar):
                 batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
+                # Token-weighted epoch aggregate (VERDICT r3 #9): each batch's
+                # mean loss/accuracy weighs by its valid-token count, so a
+                # padded final batch no longer weighs like a full one (the
+                # reference's mean-of-batch-means, main-single.py:128-137, is
+                # exact only when batches divide evenly). Counted on the host
+                # shard before device placement; multi-host this is the local
+                # shard's count — proportional, and exact when shards match.
+                weight = float((targets != -100).sum())
                 batch, targets = make_global_batch(batch_sh, batch, targets)
                 loss, acc = eval_step(state, batch, targets)
-                total_loss += float(loss)
-                total_acc += float(acc)
-                eval_metrics = {"loss": total_loss / (i + 1), "accuracy": total_acc / (i + 1)}
+                if weight > 0.0:
+                    total_loss += float(loss) * weight
+                    total_acc += float(acc) * weight
+                    total_weight += weight
+                if total_weight > 0.0:
+                    eval_metrics = {
+                        "loss": total_loss / total_weight,
+                        "accuracy": total_acc / total_weight,
+                    }
                 bar.set_description(
                     f"[validation] Epoch {epoch+1}/{epochs} | "
                     f"loss: {eval_metrics['loss']:.3f}, accuracy: {eval_metrics['accuracy']:.2f}"

@@ -58,6 +58,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--variants", type=str, default="")
+    ap.add_argument(
+        "--batches", type=str, default="",
+        help="comma list: time the base config at these batch sizes instead "
+        "of the named variants",
+    )
     args = ap.parse_args()
     seq = args.seq
 
@@ -77,11 +82,17 @@ def main():
         ("vocab2k b16", GPTConfig(**{**base, "vocab_size": 2048}), 16),
         # trunk-cost isolation: 1 layer
         ("L1 b16", GPTConfig(**{**base, "num_layers": 1}), 16),
-        # no-attention reference: heads still run but on S=128 slices? not
-        # expressible; instead scale S down at same tokens: b128 x S256
-        ("S256 b128", GPTConfig(**{**base, "max_position_embeddings": 256}), 128),
+        # (short-sequence comparisons: use --seq 256 --batches ..., which
+        # sizes the whole run consistently)
     ]
-    if args.variants:
+    if args.batches:
+        variants = []
+        for b in args.batches.split(","):
+            variants.append((f"b{b}", GPTConfig(**base), int(b)))
+            variants.append(
+                (f"b{b}+flash", GPTConfig(**base, attention_impl="flash"), int(b))
+            )
+    elif args.variants:
         keep = args.variants.split(",")
         variants = [v for v in variants if any(k in v[0] for k in keep)]
 

@@ -49,9 +49,13 @@ def initialize_runtime() -> None:
             # "... must be called before any JAX calls that might initialise
             # the XLA backend" (when the launcher initialized both for us and
             # a client is now active).
+            # "already been called"/"already initialized" are double-init
+            # races (benign); a bare "already" substring would also swallow
+            # genuine failures like "address already in use".
             if (
                 "only be called once" in msg
-                or "already" in msg
+                or "already been called" in msg
+                or "already initialized" in msg
                 or _distributed_client_active()
             ):
                 pass  # initialized by the launcher/runtime — fine

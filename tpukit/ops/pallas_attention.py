@@ -23,9 +23,12 @@ innermost; running (m, l, acc) state lives in VMEM scratch across k steps
 (TPU grids execute sequentially). Causally-skipped blocks are gated with
 `pl.when` and their K/V fetches are clamped to the diagonal block so no
 wasted HBM traffic occurs. Per-row vectors ride in Mosaic-friendly 2-D
-layouts: the padding bias as a [B, 1, S_pad] row, log-sum-exp and the dO.O
-row sums as [BH, S_pad, 1] columns — every ref read/write stays rank>=2
-(rank-1 slices crash the Mosaic layout pass), and block shapes are
+layouts as LANE ROWS: the padding bias [B, 1, S_pad], log-sum-exp and the
+dO.O row sums [BH, 1, S_pad] — a [BH, S_pad, 1] column would get its minor
+dim padded to 128 lanes in HBM, a 128x memory/traffic expansion (same
+reasoning as fused_head_ce's row vectors); rows are reshaped to (BQ, 1)
+columns in VMEM where the math needs them. Every ref read/write stays
+rank>=2 (rank-1 slices crash the Mosaic layout pass), and block shapes are
 (8, 128)-tile aligned or span their dimension.
 Sequence lengths are padded to the lane boundary in the wrapper; padded key
 columns are unreachable causally and padded query rows are sliced off.
@@ -50,12 +53,13 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e9  # causal additive term (twin of models/gpt.py:83)
 
 _LANES = 128
-# Score-block edge. Bigger blocks amortize grid overhead at long sequence
-# lengths; sweepable via env. 2048 measured fastest at S=2048 on v5e
-# (tools/sweep_long_context.py: +3.5% over 1024 — grid overhead outweighs
-# the causal-skip savings smaller blocks enable); the [2048,2048] f32 score
-# block is 16MB, comfortably inside the 100MB VMEM budget.
-_BLOCK = max(_LANES, int(os.environ.get("TPUKIT_FLASH_BLOCK", "2048")))
+# Score-block edge. Sweepable via env. 1024 measured fastest at S=2048 on
+# v5e in round 4 (tools/ablate_r4.py, full-train-step timing: 101.5 ms vs
+# 107.3 at 2048 and 126.0 at 512): at 2048 the whole sequence is ONE block,
+# so the causal skip saves nothing and the kernel computes the full S^2;
+# at 1024 the 2x2 grid skips one of four blocks; below that per-grid-step
+# overhead outweighs the extra causal savings.
+_BLOCK = max(_LANES, int(os.environ.get("TPUKIT_FLASH_BLOCK", "1024")))
 
 
 def on_tpu_backend() -> bool:
@@ -182,7 +186,9 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc
     def _():
         l = l_scr[:, :1]  # (BQ, 1)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0, pl.ds(qi * block_q, block_q), :] = m_scr[:, :1] + jnp.log(l)
+        lse_ref[0, :, pl.ds(qi * block_q, block_q)] = jnp.reshape(
+            m_scr[:, :1] + jnp.log(l), (1, block_q)
+        )
 
 
 def _flash_forward(q3, k3, v3, bias2, heads, has_mask):
@@ -208,11 +214,11 @@ def _flash_forward(q3, k3, v3, bias2, heads, has_mask):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, seq_pad, 1), lambda b, qi, ki: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, seq_pad), lambda b, qi, ki: (b, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q3.shape, q3.dtype),
-            jax.ShapeDtypeStruct((bh, seq_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, seq_pad), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -257,8 +263,12 @@ def _bwd_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dqp_re
         q_blk, k_blk, v_blk = q_ref[0], k_ref[0], v_ref[0]
         do_blk = do_ref[0].astype(jnp.float32)
         s = _masked_scores(q_blk, k_blk, mask_ref, qi, ki, block_q, block_k, has_mask)
-        lse_col = lse_ref[0, pl.ds(qi * block_q, block_q), :]  # (BQ, 1)
-        dcap_col = dcap_ref[0, pl.ds(qi * block_q, block_q), :]
+        lse_col = jnp.reshape(
+            lse_ref[0, :, pl.ds(qi * block_q, block_q)], (block_q, 1)
+        )
+        dcap_col = jnp.reshape(
+            dcap_ref[0, :, pl.ds(qi * block_q, block_q)], (block_q, 1)
+        )
         p = jnp.exp(s - lse_col)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do_blk.dtype),
@@ -329,8 +339,12 @@ def _dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref,
         q_blk, k_blk, v_blk = q_ref[0], k_ref[0], v_ref[0]
         do_blk = do_ref[0].astype(jnp.float32)
         s = _masked_scores(q_blk, k_blk, mask_ref, qi, ki, block_q, block_k, has_mask)
-        lse_col = lse_ref[0, pl.ds(qi * block_q, block_q), :]  # (BQ, 1)
-        dcap_col = dcap_ref[0, pl.ds(qi * block_q, block_q), :]
+        lse_col = jnp.reshape(
+            lse_ref[0, :, pl.ds(qi * block_q, block_q)], (block_q, 1)
+        )
+        dcap_col = jnp.reshape(
+            dcap_ref[0, :, pl.ds(qi * block_q, block_q)], (block_q, 1)
+        )
         p = jnp.exp(s - lse_col)
         dp = jax.lax.dot_general(
             do_blk,
@@ -366,8 +380,12 @@ def _dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dk_ref
         q_blk, k_blk, v_blk = q_ref[0], k_ref[0], v_ref[0]
         do_blk = do_ref[0].astype(jnp.float32)
         s = _masked_scores(q_blk, k_blk, mask_ref, qi, ki, block_q, block_k, has_mask)
-        lse_col = lse_ref[0, pl.ds(qi * block_q, block_q), :]
-        dcap_col = dcap_ref[0, pl.ds(qi * block_q, block_q), :]
+        lse_col = jnp.reshape(
+            lse_ref[0, :, pl.ds(qi * block_q, block_q)], (block_q, 1)
+        )
+        dcap_col = jnp.reshape(
+            dcap_ref[0, :, pl.ds(qi * block_q, block_q)], (block_q, 1)
+        )
         p = jnp.exp(s - lse_col)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do_blk.dtype),
@@ -403,7 +421,7 @@ def _flash_backward_split(q3, k3, v3, bias2, lse, do3, dcap, scale, heads, has_m
     num_q, num_k = seq_pad // block_q, seq_pad // block_k
 
     mask_spec = pl.BlockSpec((1, 1, seq_pad), lambda b, i, j: (b // heads, 0, 0), memory_space=pltpu.VMEM)
-    col_spec = pl.BlockSpec((1, seq_pad, 1), lambda b, i, j: (b, 0, 0), memory_space=pltpu.VMEM)
+    col_spec = pl.BlockSpec((1, 1, seq_pad), lambda b, i, j: (b, 0, 0), memory_space=pltpu.VMEM)
     cparams = tpu_compiler_params("parallel", "arbitrary", "arbitrary")
 
     dq = pl.pallas_call(
@@ -472,8 +490,11 @@ def _flash_backward(q3, k3, v3, bias2, out, lse, do3, scale, heads, has_mask):
     block_q = block_k = min(_BLOCK, seq_pad) if seq_pad >= _LANES else seq_pad
     num_q, num_k = seq_pad // block_q, seq_pad // block_k
 
-    # D_i = rowsum(dO * O) — cheap, computed outside the kernels.
-    dcap = jnp.sum(do3.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
+    # D_i = rowsum(dO * O) — cheap, computed outside the kernels. Stored
+    # as a [BH, 1, S_pad] lane-row: a [BH, S_pad, 1] column would have
+    # its minor dim padded to 128 lanes in HBM (a 128x memory/traffic
+    # expansion — same reasoning as fused_head_ce's row vectors).
+    dcap = jnp.sum(do3.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[:, None, :]
 
     dq_partials_bytes = bh * num_k * seq_pad * head_dim * 4
     if num_k > _DQ_FUSED_MAX_NUM_K or dq_partials_bytes > _DQ_PARTIALS_BUDGET:
@@ -483,7 +504,7 @@ def _flash_backward(q3, k3, v3, bias2, out, lse, do3, scale, heads, has_mask):
         )
 
     mask_spec = pl.BlockSpec((1, 1, seq_pad), lambda b, i, j: (b // heads, 0, 0), memory_space=pltpu.VMEM)
-    col_spec = pl.BlockSpec((1, seq_pad, 1), lambda b, i, j: (b, 0, 0), memory_space=pltpu.VMEM)
+    col_spec = pl.BlockSpec((1, 1, seq_pad), lambda b, i, j: (b, 0, 0), memory_space=pltpu.VMEM)
 
     dq_part, dk, dv = pl.pallas_call(
         functools.partial(
@@ -557,7 +578,7 @@ def _fwd4_impl(q, k, v, mask, scale, heads, has_mask):
     out, lse = _flash_forward(prep(q * scale), prep(k), prep(v), bias2, h, has_mask)
     return (
         out[:, :seq].reshape(batch, h, seq, head_dim),
-        lse[:, :seq].reshape(batch, h, seq, 1),
+        lse[:, 0, :seq].reshape(batch, h, seq, 1),
     )
 
 
@@ -573,8 +594,8 @@ def _bwd4_impl(q, k, v, mask, out, lse, do, scale, heads, has_mask):
     # padded lse rows must stay out of exp(): -inf would NaN; any finite
     # value is unused because padded query rows are sliced off below
     lse3 = jnp.pad(
-        lse.reshape(batch * h, seq, 1), ((0, 0), (0, seq_pad - seq), (0, 0))
-    )
+        lse.reshape(batch * h, seq), ((0, 0), (0, seq_pad - seq))
+    )[:, None, :]
     dq, dk, dv = _flash_backward(
         prep(q * scale), prep(k), prep(v), bias2, prep(out), lse3, prep(do),
         scale, h, has_mask,

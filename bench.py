@@ -122,6 +122,40 @@ def main():
     except Exception as exc:  # stdout is reserved for the JSON line
         print(f"long-context bench failed: {exc!r}", file=sys.stderr)
 
+    # FSDP --cpu_offload proof (VERDICT r3 #6): run the donated train step
+    # with params/opt state pinned to HOST memory on the real chip and
+    # record that the state is still host-pinned afterwards — the positive
+    # path that CPU tests can only fake (they assert the degrade warning).
+    offload_ok, offload_tps = None, None
+    try:
+        from tpukit.mesh import create_mesh
+        from tpukit.shardings import FSDP
+
+        strat_o = FSDP(mesh=create_mesh({"data": n_dev}), cpu_offload=True)
+        if strat_o._offload_supported():
+            state_o = create_train_state(jax.random.PRNGKey(0), cfg, optimizer)
+            shapes_o = jax.eval_shape(lambda: state_o)
+            step_o, _, sh_o = make_step_fns(cfg, optimizer, strat_o, shapes_o)
+            state_o = jax.device_put(state_o, sh_o)
+            kinds = {l.sharding.memory_kind for l in jax.tree.leaves(state_o.params)}
+            assert kinds == {"pinned_host"}, kinds
+            for _ in range(2):
+                state_o, loss_o = step_o(state_o, model_batch, targets)
+            float(loss_o)
+            t0 = time.perf_counter()
+            for _ in range(6):
+                state_o, loss_o = step_o(state_o, model_batch, targets)
+            float(loss_o)
+            dt = time.perf_counter() - t0
+            kinds = {l.sharding.memory_kind for l in jax.tree.leaves(state_o.params)}
+            assert kinds == {"pinned_host"}, kinds
+            offload_ok = True
+            offload_tps = 6 * batch * (seq - 1) / dt / n_dev
+            del state_o
+    except Exception as exc:
+        offload_ok = False
+        print(f"fsdp cpu_offload probe failed: {exc!r}", file=sys.stderr)
+
     result = {
         "metric": "gpt_train_tokens_per_sec_per_chip",
         "value": round(tps_chip, 1),
@@ -130,6 +164,8 @@ def main():
         "mfu": round(mfu, 4) if mfu is not None else None,
         "tokens_per_sec_total": round(tps, 1),
         "long_context_s2048_tokens_per_sec_per_chip": round(long_tps, 1) if long_tps else None,
+        "fsdp_cpu_offload_ok": offload_ok,
+        "fsdp_cpu_offload_tokens_per_sec_per_chip": round(offload_tps, 1) if offload_tps else None,
         "chips": n_dev,
         "device": jax.devices()[0].device_kind,
         "config": f"GPT-20M dim256 L8 seq256 bf16 batch{batch}, fused train step",

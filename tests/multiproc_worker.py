@@ -1,0 +1,64 @@
+"""Worker process for tests/test_multiprocess.py.
+
+Launched N times (once per simulated host) with:
+  TPUKIT_CPU_DEVICES=<local devices>  JAX_COORDINATOR_ADDRESS=localhost:<p>
+  JAX_NUM_PROCESSES=<N>  JAX_PROCESS_ID=<rank>
+
+Order matters and is the same contract every real multi-host tpukit launch
+follows: configure the platform (import tpukit), then `initialize_runtime()`
+BEFORE any backend-initializing JAX call, then run the recipe untouched.
+This file is the CPU-localhost twin of `torchrun main-fsdp.py` on two nodes
+(reference main-ddp.py:1-6, main-fsdp.py:1-6).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import tpukit  # noqa: F401  (TPUKIT_CPU_DEVICES -> cpu platform config)
+from tpukit.mesh import initialize_runtime  # noqa: E402
+
+initialize_runtime()
+
+import jax  # noqa: E402
+
+
+def main() -> None:
+    recipe = sys.argv[1]  # e.g. "main-fsdp.py"
+    workdir = sys.argv[2]  # shared dir: checkpoints + outputs land here
+    out_path = sys.argv[3]
+    recipe_args = sys.argv[4:]
+
+    spec = importlib.util.spec_from_file_location(
+        recipe.replace("-", "_").replace(".py", ""), REPO / recipe
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    os.chdir(workdir)
+    result = mod.main(recipe_args)
+
+    out = {
+        "rank": jax.process_index(),
+        "world": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "eval_loss": float(result.metrics["eval"]["loss"]),
+        "eval_accuracy": float(result.metrics["eval"]["accuracy"]),
+        "step": int(jax.device_get(result.state.step)),
+        "checkpoint": str(result.checkpoint_path),
+        "checkpoint_exists": result.checkpoint_path is not None
+        and Path(result.checkpoint_path).exists(),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()

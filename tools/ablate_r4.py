@@ -95,6 +95,10 @@ def main():
     elif args.variants:
         keep = args.variants.split(",")
         variants = [v for v in variants if any(k in v[0] for k in keep)]
+        if not variants:
+            raise SystemExit(f"--variants {args.variants!r} matched nothing")
+    if args.batches and args.variants:
+        raise SystemExit("--batches and --variants are mutually exclusive")
 
     peak = peak_flops_per_chip()
     for name, cfg, b in variants:

@@ -1,0 +1,100 @@
+"""Measure pipeline live-activation memory vs micro-batch count (VERDICT
+r3 #8): XLA's compiled memory analysis of the REAL pipeline train step on a
+virtual stage mesh, with and without --remat.
+
+The GPipe schedule scans num_micro + num_stages - 1 steps and autodiff
+saves residuals for every step, so temp memory grows linearly with the
+micro-batch count; per-layer remat trades that slope for recompute. This
+tool prints the measured slope so ladder configs (BASELINE.json GPT-large/
+XL) can size micro-batch counts; docs/DESIGN.md records the numbers.
+
+    TPUKIT_CPU_DEVICES=8 python tools/pipeline_memory.py [--ladder]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+os.environ.setdefault("TPUKIT_CPU_DEVICES", "8")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def temp_bytes(cfg, strat, micro_rows: int):
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    opt = make_optimizer(1e-4)
+    state = create_train_state(jax.random.PRNGKey(0), cfg, opt, strategy=strat)
+    shapes = jax.eval_shape(lambda: state)
+    step, _, sh = make_step_fns(cfg, opt, strat, shapes)
+    state = jax.device_put(state, sh)
+    seq = cfg.max_position_embeddings - 1
+    ids = np.zeros((micro_rows, seq), np.int32)
+    batch = {
+        "input_ids": ids,
+        "position_ids": np.zeros_like(ids),
+        "mask": np.zeros(ids.shape, bool),
+    }
+    ma = step.lower(state, batch, np.zeros_like(ids)).compile().memory_analysis()
+    return ma.temp_size_in_bytes
+
+
+def sweep(cfg, stages: int, micros, rows_per_micro: int = 1):
+    from tpukit.mesh import create_mesh
+    from tpukit.pipeline import Pipeline
+
+    mesh = create_mesh({"stage": stages})
+    for remat in (False, True):
+        c = cfg.replace(remat_layers=remat)
+        sizes = []
+        for m in micros:
+            strat = Pipeline(mesh, num_microbatches=m)
+            sizes.append(temp_bytes(c, strat, m * rows_per_micro))
+        slope = (sizes[-1] - sizes[0]) / (micros[-1] - micros[0])
+        tag = "remat" if remat else "plain"
+        print(
+            f"  {tag:>5}: "
+            + ", ".join(f"M={m}: {s/2**20:7.2f} MiB" for m, s in zip(micros, sizes))
+            + f"   slope {slope/2**20:.3f} MiB/micro"
+        )
+
+
+def main():
+    from tpukit.model import GPTConfig
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ladder", action="store_true", help="include GPT-large/XL shapes")
+    args = ap.parse_args()
+
+    base = dict(vocab_size=512, compute_dtype=jnp.bfloat16, scan_layers=True)
+
+    print("GPT-tiny dim64 L8 seq64, 8 stages:")
+    sweep(
+        GPTConfig(dim=64, head_dim=16, heads=4, num_layers=8,
+                  max_position_embeddings=64, **base),
+        stages=8, micros=(8, 16, 32),
+    )
+
+    if args.ladder:
+        # BASELINE.json configs 4-5 shapes (GPT-large/XL class); small vocab
+        # keeps CPU compile time sane — embeddings do not affect the per-
+        # micro activation slope, which is what this tool measures.
+        print("GPT-large-class dim1280 L16(of 36) seq512, 4 stages:")
+        sweep(
+            GPTConfig(dim=1280, head_dim=64, heads=20, num_layers=16,
+                      max_position_embeddings=512, **base),
+            stages=4, micros=(4, 8, 16),
+        )
+        print("GPT-XL-class dim1600 L16(of 48) seq512, 8 stages:")
+        sweep(
+            GPTConfig(dim=1600, head_dim=64, heads=25, num_layers=16,
+                      max_position_embeddings=512, **base),
+            stages=8, micros=(8, 16),
+        )
+
+
+if __name__ == "__main__":
+    main()

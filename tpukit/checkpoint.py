@@ -243,7 +243,13 @@ def save_sharded(state, directory: str | os.PathLike = "checkpoints", name: str 
     checkpoint directory. Atomic publish: everything is written into a
     `.tmp` directory that process 0 renames only after all processes have
     finished their shard files — a crash mid-save leaves no directory that
-    `latest_sharded`/`restore_sharded` would pick up."""
+    `latest_sharded`/`restore_sharded` would pick up.
+
+    Multi-host runs require `directory` on a SHARED filesystem: restore
+    needs every process's shard file, and the atomic publish is a single
+    process-0 rename (the same contract as torch.distributed checkpoint
+    dirs). On host-local paths each host would publish only its own shards.
+    """
     import json
 
     import numpy as np
@@ -276,7 +282,12 @@ def save_sharded(state, directory: str | os.PathLike = "checkpoints", name: str 
             starts = [s.start or 0 for s in shard.index] if shard.index else []
             key = f"{i}|{','.join(map(str, starts))}"
             blocks[key] = np.asarray(shard.data)
-    np.savez(tmp / f"shard-{jax.process_index():05d}.npz", **blocks)
+    mine = tmp / f"shard-{jax.process_index():05d}.npz"
+    # Belt and braces for non-shared paths (ADVICE r3): process 0's rmtree
+    # above only clears stale tmp files IT can see; each process also clears
+    # its own target so a crashed save's leftover cannot survive locally.
+    mine.unlink(missing_ok=True)
+    np.savez(mine, **blocks)
 
     if is_process_zero():
         manifest = {
@@ -348,7 +359,20 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
 
     base = Path(path)
     manifest = json.loads((base / "manifest.json").read_text())
-    shard_files = sorted(base.glob("shard-*.npz"))
+    # Exactly the files the manifest's world wrote — a stale extra
+    # shard-*.npz (e.g. from a crashed save under a different world size,
+    # on a filesystem where the pre-save cleanup could not see it) must not
+    # be read into the restore.
+    shard_files = [
+        base / f"shard-{pid:05d}.npz" for pid in range(manifest["nprocs"])
+    ]
+    missing = [str(f) for f in shard_files if not f.exists()]
+    if missing:
+        raise FileNotFoundError(
+            f"checkpoint {base}: missing shard files {missing} (saved from "
+            f"{manifest['nprocs']} processes; are all shard files on this "
+            f"filesystem?)"
+        )
     archives = [np.load(f) for f in shard_files]
 
     flat, treedef = jax.tree_util.tree_flatten(template)

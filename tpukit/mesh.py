@@ -40,8 +40,20 @@ def initialize_runtime() -> None:
     # after the XLA backend exists. So multi-host detection here is env-only.
     explicit = bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if explicit or _pod_env_detected():
+        # jax.distributed.initialize has no env-var fallback for the process
+        # count/rank (only launchers/cluster detection supply them), so an
+        # explicit-coordinator launch passes them through from the
+        # environment: the torchrun-style contract (reference main-ddp.py:1-6
+        # rendezvous) without a launcher dependency.
+        kwargs = {}
+        if explicit:
+            kwargs["coordinator_address"] = os.environ["JAX_COORDINATOR_ADDRESS"]
+            if os.environ.get("JAX_NUM_PROCESSES"):
+                kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+            if os.environ.get("JAX_PROCESS_ID"):
+                kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
         try:
-            jax.distributed.initialize()
+            jax.distributed.initialize(**kwargs)
         except Exception as exc:
             msg = str(exc).lower()
             # Actual JAX error texts for the two benign races:

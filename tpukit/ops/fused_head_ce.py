@@ -86,6 +86,11 @@ def _fwd_kernel(tgt_ref, h_ref, w_ref, lse_ref, tgtl_ref, best_ref,
         preferred_element_type=jnp.float32,
     )
     cols = _tile_cols(vi, v_blk)  # (1, Vc) global column ids
+    # NB: a closed-form pad-column correction (zero the pad columns of w,
+    # skip this where, subtract pad_cnt*exp(-m) from l) was tried and
+    # REVERTED: when every real logit is far below 0 the pad columns anchor
+    # m at 0 and the real mass cancels below the f32 ulp of the pad mass —
+    # lse collapses to -inf for any token with true logsumexp < ~-9.7.
     logits = jnp.where(cols < vocab_size, logits, NEG_INF)
 
     # online logsumexp over vocab tiles

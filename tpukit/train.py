@@ -36,6 +36,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import functools
+import os
 from functools import partial
 from typing import Any, Callable
 
@@ -47,7 +48,7 @@ from flax import struct
 from tqdm import tqdm
 
 from tpukit import checkpoint as ckpt_lib
-from tpukit.batching import prepare_batch
+from tpukit.batching import IGNORE_INDEX, prepare_batch
 from tpukit.data import get_dataset, get_tokenizer, transform_dataset
 from tpukit.flags import TrainFlags
 from tpukit.loader import DataLoader
@@ -179,6 +180,14 @@ def make_global_batch(batch_sharding, model_batch, targets):
     return jax.tree.map(conv, model_batch), conv(targets)
 
 
+@jax.jit
+def _valid_count(targets):
+    """Global valid-token count of a (possibly cross-host sharded) targets
+    array. jit makes the sum a collective under GSPMD, so every process sees
+    the same number — a host-side count would only cover the local shard."""
+    return jnp.sum(targets != IGNORE_INDEX)
+
+
 @functools.lru_cache(maxsize=None)
 def _replicator(mesh):
     """One jitted all-gather-to-replicated program per mesh — rebuilding the
@@ -189,16 +198,29 @@ def _replicator(mesh):
     return jax.jit(lambda p: p, out_shardings=repl)
 
 
-def replicated_params(strategy: Strategy, state: TrainState):
-    """An addressable, fully-replicated copy of the state's parameters.
+_REPLICATE_LIMIT = (
+    int(os.environ.get("TPUKIT_REPLICATE_PARAMS_MB", "1024")) * 2**20
+)
 
-    The decode loop needs every parameter on every host: running it on
-    process 0 with params still sharded across hosts is the reference's
+
+def replicated_params(strategy: Strategy, state: TrainState):
+    """Parameters addressable on every host for the decode loop — running it
+    on process 0 with params still sharded across hosts is the reference's
     latent multi-host hang (rank-0-only FSDP generate, main-ddp.py:170-174,
-    SURVEY §3.5). This is a collective — EVERY process must call it — and
-    the jit identity lets GSPMD emit the all-gathers (and, for offloaded
-    FSDP state, the host->device copies) in one compiled program.
+    SURVEY §3.5). This is a collective — EVERY process must call it.
+
+    Small models get a fully-replicated copy (one compiled all-gather, then
+    the 20-step decode runs gather-free). Past TPUKIT_REPLICATE_PARAMS_MB
+    (default 1 GiB — ADVICE r3: FSDP configs that shard out of memory
+    necessity would OOM on a transient full copy) the sharded params are
+    returned as-is and the decode jit lets GSPMD gather per-op: one layer's
+    parameters live at a time instead of all of them.
     """
+    total = sum(
+        l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(state.params)
+    )
+    if total > _REPLICATE_LIMIT:
+        return state.params
     return _replicator(strategy.mesh)(state.params)
 
 
@@ -435,15 +457,17 @@ def fit(
             eval_metrics = {"loss": float("nan"), "accuracy": float("nan")}
             for i, raw in enumerate(bar):
                 batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
+                batch, targets = make_global_batch(batch_sh, batch, targets)
                 # Token-weighted epoch aggregate (VERDICT r3 #9): each batch's
                 # mean loss/accuracy weighs by its valid-token count, so a
                 # padded final batch no longer weighs like a full one (the
                 # reference's mean-of-batch-means, main-single.py:128-137, is
-                # exact only when batches divide evenly). Counted on the host
-                # shard before device placement; multi-host this is the local
-                # shard's count — proportional, and exact when shards match.
-                weight = float((targets != -100).sum())
-                batch, targets = make_global_batch(batch_sh, batch, targets)
+                # exact only when batches divide evenly). Counted on the
+                # GLOBAL targets (a jitted reduction over the sharded array),
+                # so every process aggregates with the same weights — a
+                # host-local count would make ranks disagree about the epoch
+                # metric (caught by tests/test_multiprocess.py).
+                weight = float(_valid_count(targets))
                 loss, acc = eval_step(state, batch, targets)
                 if weight > 0.0:
                     total_loss += float(loss) * weight

@@ -81,9 +81,17 @@ def _launch_world(recipe, workdir, extra=(), nprocs=2, local_devices=4, timeout=
                 text=True,
             )
         )
-    logs = [p.communicate(timeout=timeout)[0] for p in procs]
-    for rank, (p, log) in enumerate(zip(procs, logs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{log[-4000:]}"
+    try:
+        logs = [p.communicate(timeout=timeout)[0] for p in procs]
+        for rank, (p, log) in enumerate(zip(procs, logs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{log[-4000:]}"
+    finally:
+        # one rank hanging (e.g. a failed rendezvous) must not orphan the
+        # others — they hold the coordinator port for later tests
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     results = [json.loads(o.read_text()) for o in outs]
     for rank, r in enumerate(results):
         assert r["rank"] == rank and r["world"] == nprocs

@@ -282,12 +282,7 @@ def save_sharded(state, directory: str | os.PathLike = "checkpoints", name: str 
             starts = [s.start or 0 for s in shard.index] if shard.index else []
             key = f"{i}|{','.join(map(str, starts))}"
             blocks[key] = np.asarray(shard.data)
-    mine = tmp / f"shard-{jax.process_index():05d}.npz"
-    # Belt and braces for non-shared paths (ADVICE r3): process 0's rmtree
-    # above only clears stale tmp files IT can see; each process also clears
-    # its own target so a crashed save's leftover cannot survive locally.
-    mine.unlink(missing_ok=True)
-    np.savez(mine, **blocks)
+    np.savez(tmp / f"shard-{jax.process_index():05d}.npz", **blocks)
 
     if is_process_zero():
         manifest = {

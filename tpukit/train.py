@@ -198,11 +198,6 @@ def _replicator(mesh):
     return jax.jit(lambda p: p, out_shardings=repl)
 
 
-_REPLICATE_LIMIT = (
-    int(os.environ.get("TPUKIT_REPLICATE_PARAMS_MB", "1024")) * 2**20
-)
-
-
 def replicated_params(strategy: Strategy, state: TrainState):
     """Parameters addressable on every host for the decode loop — running it
     on process 0 with params still sharded across hosts is the reference's
@@ -212,15 +207,18 @@ def replicated_params(strategy: Strategy, state: TrainState):
     Small models get a fully-replicated copy (one compiled all-gather, then
     the 20-step decode runs gather-free). Past TPUKIT_REPLICATE_PARAMS_MB
     (default 1 GiB — ADVICE r3: FSDP configs that shard out of memory
-    necessity would OOM on a transient full copy) the sharded params are
-    returned as-is and the decode jit lets GSPMD gather per-op: one layer's
-    parameters live at a time instead of all of them.
+    necessity would OOM on a transient full copy) the params keep their
+    sharded layout — routed through `strategy.to_compute` so offloaded
+    (pinned_host) state still moves into device memory — and the decode jit
+    lets GSPMD gather per-op: one layer's parameters live at a time instead
+    of all of them.
     """
+    limit = int(os.environ.get("TPUKIT_REPLICATE_PARAMS_MB", "1024")) * 2**20
     total = sum(
         l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(state.params)
     )
-    if total > _REPLICATE_LIMIT:
-        return state.params
+    if total > limit:
+        return strategy.to_compute(state).params
     return _replicator(strategy.mesh)(state.params)
 
 

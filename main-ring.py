@@ -13,6 +13,12 @@ Use it when one chip can't hold the sequence:
   python main-ring.py --sequence_length 8192 --batch_size 4 ...
 (sequence_length - 1 must divide by the number of sequence shards; on an
 8-device mesh the default grid is seq=8.)
+
+`--cp_attention ulysses` swaps the ring for all-to-all sequence
+parallelism (DeepSpeed-Ulysses style): two all_to_alls re-partition heads
+over the seq axis and each device runs full-sequence flash attention on
+its head subset — fewer collectives per layer, requires heads divisible
+by the shard count.
 """
 
 from tpukit.flags import parse_flags
@@ -21,8 +27,8 @@ from tpukit.train import fit
 
 
 def main(argv=None):
-    flags = parse_flags(argv)
-    return fit(flags, ContextParallel())
+    flags = parse_flags(argv, cp_attention=True)
+    return fit(flags, ContextParallel(attention=flags.cp_attention))
 
 
 if __name__ == "__main__":

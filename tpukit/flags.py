@@ -66,6 +66,10 @@ class TrainFlags:
     # the GPipe bubble to ~16%); the reference ties it to the stage count
     # (chunks=num_stages, main-pipe.py:83) — pass it explicitly for that.
     microbatches: int = 0
+    # main-ring.py only: sequence-parallel attention schedule — "ring"
+    # (zigzag-balanced ppermute hops) or "ulysses" (all_to_all head
+    # re-partitioning; needs heads % seq_shards == 0).
+    cp_attention: str = "ring"
 
 
 # The canonical 12 flags of every reference recipe (main-single.py:156-167).
@@ -83,7 +87,7 @@ _CORE_FLAGS = [
 ]
 
 
-def build_parser(cpu_offload: bool = False) -> argparse.ArgumentParser:
+def build_parser(cpu_offload: bool = False, cp_attention: bool = False) -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser()
     defaults = TrainFlags()
     for name, typ in _CORE_FLAGS:
@@ -92,6 +96,10 @@ def build_parser(cpu_offload: bool = False) -> argparse.ArgumentParser:
     parser.add_argument("--disable_compile", action="store_true")
     if cpu_offload:
         parser.add_argument("--cpu_offload", action="store_true")
+    if cp_attention:
+        parser.add_argument(
+            "--cp_attention", choices=("ring", "ulysses"), default="ring"
+        )
     parser.add_argument("--seed", type=int, default=defaults.seed)
     parser.add_argument("--dropout", type=float, default=defaults.dropout)
     parser.add_argument("--checkpoint_every", type=int, default=defaults.checkpoint_every)
@@ -110,8 +118,11 @@ def build_parser(cpu_offload: bool = False) -> argparse.ArgumentParser:
     return parser
 
 
-def parse_flags(argv=None, cpu_offload: bool = False) -> TrainFlags:
-    ns = build_parser(cpu_offload=cpu_offload).parse_args(argv)
+def parse_flags(
+    argv=None, cpu_offload: bool = False, cp_attention: bool = False
+) -> TrainFlags:
+    ns = build_parser(cpu_offload=cpu_offload, cp_attention=cp_attention).parse_args(argv)
     kw = vars(ns)
     kw.setdefault("cpu_offload", False)
+    kw.setdefault("cp_attention", "ring")
     return TrainFlags(**kw)

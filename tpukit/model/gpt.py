@@ -75,7 +75,7 @@ class GPTConfig:
     # Pallas flash kernel (tpukit/ops/pallas_attention.py) at 512 and above.
     # "ring" runs sequence-sharded ring attention (tpukit/ring_attention.py)
     # over the `ring_axis` mesh axis — only valid inside shard_map.
-    attention_impl: str = "auto"  # "auto" | "xla" | "flash" | "ring"
+    attention_impl: str = "auto"  # "auto" | "xla" | "flash" | "ring" | "ulysses"
     ring_axis: str = "seq"
     # Sequence layout of the ring shards: "contiguous" (device d holds rows
     # [d*Sl, (d+1)*Sl)) or "zigzag" (device d holds chunks d and 2P-1-d of

@@ -73,6 +73,12 @@ def causal_attention(
             q, k, v, scale=scale, axis_name=ring_axis, pad_mask=pad_mask,
             layout=ring_layout,
         )
+    if impl == "ulysses":
+        from tpukit.ring_attention import ulysses_attention
+
+        return ulysses_attention(
+            q, k, v, scale=scale, axis_name=ring_axis, pad_mask=pad_mask
+        )
 
     seq_len = q.shape[2]
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale

@@ -272,10 +272,20 @@ class ContextParallel(Strategy):
 
     name = "cp"
 
-    def __init__(self, mesh: Mesh | None = None):
+    def __init__(self, mesh: Mesh | None = None, attention: str = "ring"):
+        """`attention` picks the sequence-parallel schedule:
+        "ring" (default) — K/V ppermute hops, zigzag-balanced, works for
+        any head count; "ulysses" — two all_to_alls re-partition to
+        head-sharding and run full-sequence flash attention locally
+        (needs heads % seq_shards == 0). See tpukit/ring_attention.py."""
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"seq": -1})
         if "seq" not in self.mesh.axis_names:
             raise ValueError("ContextParallel needs a 'seq' mesh axis")
+        if attention not in ("ring", "ulysses"):
+            raise ValueError(f"attention must be 'ring' or 'ulysses', got {attention!r}")
+        self.attention = attention
+        if attention == "ulysses":
+            self.name = "cp-ulysses"
         self.seq_size = self.mesh.shape["seq"]
         self.data_size = self.mesh.shape.get("data", 1)
 
@@ -293,6 +303,12 @@ class ContextParallel(Strategy):
                 f"sequence {seq} must divide over {self.seq_size} sequence "
                 f"shards; pick sequence_length = k*{self.seq_size} + 1"
             )
+        if self.attention == "ulysses" and cfg.heads % self.seq_size:
+            raise ValueError(
+                f"ulysses attention re-partitions heads over the seq axis: "
+                f"--heads {cfg.heads} must divide by {self.seq_size} "
+                f"sequence shards (or use attention='ring')"
+            )
 
     def loss_fn(
         self, params, cfg: gpt.GPTConfig, batch, targets,
@@ -309,7 +325,13 @@ class ContextParallel(Strategy):
         # chunk; every per-token computation (embeddings, MLPs, CE sums) is
         # permutation-invariant, so only the ring schedule needs to know.
         # Falls back to the contiguous ring when 2*P doesn't divide S.
-        use_zigzag = seq_len % (2 * self.seq_size) == 0 and self.seq_size > 1
+        # The ulysses schedule keeps the contiguous layout (its local
+        # attention sees the full gathered sequence).
+        use_zigzag = (
+            self.attention == "ring"
+            and seq_len % (2 * self.seq_size) == 0
+            and self.seq_size > 1
+        )
         if use_zigzag:
             from tpukit.ring_attention import zigzag_order
 
@@ -317,7 +339,7 @@ class ContextParallel(Strategy):
             batch = {key: val[:, order] for key, val in batch.items()}
             targets = targets[:, order]
         local_cfg = cfg.replace(
-            attention_impl="ring",
+            attention_impl="ring" if self.attention == "ring" else "ulysses",
             ring_axis="seq",
             ring_layout="zigzag" if use_zigzag else "contiguous",
         )

@@ -21,7 +21,7 @@ import jax
 
 from tpukit.flags import parse_flags
 from tpukit.mesh import create_mesh
-from tpukit.pipeline import Pipeline
+from tpukit.pipeline import Pipeline, Pipeline1F1B
 from tpukit.train import fit
 
 
@@ -35,11 +35,12 @@ def pick_grid(n_devices: int, num_layers: int) -> dict:
 
 
 def main(argv=None):
-    flags = parse_flags(argv)
+    flags = parse_flags(argv, pipeline_schedule=True)
+    cls = Pipeline1F1B if flags.pipeline_schedule == "1f1b" else Pipeline
     grid = pick_grid(len(jax.devices()), flags.num_layers)
     return fit(
         flags,
-        Pipeline(create_mesh(grid), num_microbatches=flags.microbatches or "4x"),
+        cls(create_mesh(grid), num_microbatches=flags.microbatches or "4x"),
     )
 
 

@@ -22,15 +22,16 @@ Run: `python main-pipe.py --batch_size 64 --num_layers 8 ...`
 """
 
 from tpukit.flags import parse_flags
-from tpukit.pipeline import Pipeline
+from tpukit.pipeline import Pipeline, Pipeline1F1B
 from tpukit.train import fit
 
 
 def main(argv=None):
-    flags = parse_flags(argv)
+    flags = parse_flags(argv, pipeline_schedule=True)
+    cls = Pipeline1F1B if flags.pipeline_schedule == "1f1b" else Pipeline
     # 4x micro-batches per stage shrink the GPipe bubble (divergence from
     # the reference's chunks=num_stages; --microbatches N restores it)
-    return fit(flags, Pipeline(num_microbatches=flags.microbatches or "4x"))
+    return fit(flags, cls(num_microbatches=flags.microbatches or "4x"))
 
 
 if __name__ == "__main__":

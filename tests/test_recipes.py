@@ -107,3 +107,11 @@ def test_recipe_fsdp_sharded_checkpoint_and_resume(tmp_path):
         extra=["--checkpoint_format", "sharded", "--resume", "latest"],
     )
     assert int(resumed.state.step) == 2 * int(result.state.step)
+
+
+def test_recipe_pipe_1f1b(tmp_path):
+    # the explicit-vjp 1F1B schedule through the full recipe surface
+    _run_recipe(
+        "main-pipe.py", tmp_path,
+        extra=["--num_layers", "8", "--microbatches", "8", "--schedule", "1f1b"],
+    )

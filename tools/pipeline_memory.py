@@ -44,17 +44,21 @@ def temp_bytes(cfg, strat, micro_rows: int):
 
 def sweep(cfg, stages: int, micros, rows_per_micro: int = 1):
     from tpukit.mesh import create_mesh
-    from tpukit.pipeline import Pipeline
+    from tpukit.pipeline import Pipeline, Pipeline1F1B
 
     mesh = create_mesh({"stage": stages})
-    for remat in (False, True):
+    rows = [
+        ("plain", Pipeline, False),
+        ("remat", Pipeline, True),
+        ("1f1b", Pipeline1F1B, False),
+    ]
+    for tag, cls, remat in rows:
         c = cfg.replace(remat_layers=remat)
         sizes = []
         for m in micros:
-            strat = Pipeline(mesh, num_microbatches=m)
+            strat = cls(mesh, num_microbatches=m)
             sizes.append(temp_bytes(c, strat, m * rows_per_micro))
         slope = (sizes[-1] - sizes[0]) / (micros[-1] - micros[0])
-        tag = "remat" if remat else "plain"
         print(
             f"  {tag:>5}: "
             + ", ".join(f"M={m}: {s/2**20:7.2f} MiB" for m, s in zip(micros, sizes))

@@ -70,6 +70,10 @@ class TrainFlags:
     # (zigzag-balanced ppermute hops) or "ulysses" (all_to_all head
     # re-partitioning; needs heads % seq_shards == 0).
     cp_attention: str = "ring"
+    # pipeline recipes only: "gpipe" (autodiff schedule, vocab-sharded
+    # embeddings/head) or "1f1b" (explicit per-stage vjps — activation
+    # memory bounded by the stage count instead of the micro count).
+    pipeline_schedule: str = "gpipe"
 
 
 # The canonical 12 flags of every reference recipe (main-single.py:156-167).
@@ -87,7 +91,11 @@ _CORE_FLAGS = [
 ]
 
 
-def build_parser(cpu_offload: bool = False, cp_attention: bool = False) -> argparse.ArgumentParser:
+def build_parser(
+    cpu_offload: bool = False,
+    cp_attention: bool = False,
+    pipeline_schedule: bool = False,
+) -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser()
     defaults = TrainFlags()
     for name, typ in _CORE_FLAGS:
@@ -99,6 +107,11 @@ def build_parser(cpu_offload: bool = False, cp_attention: bool = False) -> argpa
     if cp_attention:
         parser.add_argument(
             "--cp_attention", choices=("ring", "ulysses"), default="ring"
+        )
+    if pipeline_schedule:
+        parser.add_argument(
+            "--schedule", dest="pipeline_schedule",
+            choices=("gpipe", "1f1b"), default="gpipe",
         )
     parser.add_argument("--seed", type=int, default=defaults.seed)
     parser.add_argument("--dropout", type=float, default=defaults.dropout)
@@ -119,10 +132,18 @@ def build_parser(cpu_offload: bool = False, cp_attention: bool = False) -> argpa
 
 
 def parse_flags(
-    argv=None, cpu_offload: bool = False, cp_attention: bool = False
+    argv=None,
+    cpu_offload: bool = False,
+    cp_attention: bool = False,
+    pipeline_schedule: bool = False,
 ) -> TrainFlags:
-    ns = build_parser(cpu_offload=cpu_offload, cp_attention=cp_attention).parse_args(argv)
+    ns = build_parser(
+        cpu_offload=cpu_offload,
+        cp_attention=cp_attention,
+        pipeline_schedule=pipeline_schedule,
+    ).parse_args(argv)
     kw = vars(ns)
     kw.setdefault("cpu_offload", False)
     kw.setdefault("cp_attention", "ring")
+    kw.setdefault("pipeline_schedule", "gpipe")
     return TrainFlags(**kw)

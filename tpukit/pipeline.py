@@ -483,3 +483,228 @@ class Pipeline(Strategy):
         loss = loss_sum / denom
         accuracy = correct / denom * 100.0
         return loss, accuracy
+
+
+class Pipeline1F1B(Pipeline):
+    """1F1B pipeline schedule: activation memory bounded by the STAGE count.
+
+    The GPipe parent differentiates its whole schedule with autodiff, so
+    residuals for every scheduled step stay live until the backward — temp
+    memory grows linearly with the micro-batch count (measured in
+    docs/DESIGN.md). Here the training gradient is built EXPLICITLY inside
+    the tick loop: each tick, every stage runs one primal forward (sending
+    its activation on) and one remat-style `jax.vjp` backward for the
+    oldest outstanding micro-batch (recomputing the stage forward from the
+    saved stage INPUT, then transposing with the cotangent that arrived
+    from the next stage). The scan itself is never differentiated, so each
+    tick's internals are freed by XLA as it retires; the only persistent
+    activation state is a depth-2S ring buffer of stage inputs —
+    independent of the micro-batch count.
+
+    Scheduling is correct-by-dataflow: validity flags travel with the
+    forward activations and backward cotangents, invalid work is computed
+    but masked to zero (a vjp is linear in its cotangent, so a zero
+    cotangent contributes exactly zero gradient), and per-stage counters
+    pace the in-order micro-batch streams. The last stage triggers its own
+    backward the same tick as its forward — the 1F1B interleave. Ticks:
+    num_micro + 2*num_stages (the bubble is the standard 1F1B one; the
+    win is memory, not bubble).
+
+    Divergences from the parent (documented, deliberate):
+      - embeddings and lm_head stay REPLICATED across stages (no
+        vocab-over-stage sharding): the explicit-vjp schedule would need a
+        hand-written vocab-parallel CE transpose; use the GPipe schedule
+        when vocab sharding matters more than activation memory.
+      - eval reuses the parent's forward-only schedule (loss_fn).
+    Dropout keys derive from (stage, micro) — not the tick — so the
+    backward's recompute sees exactly the forward's mask.
+    """
+
+    name = "pipe-1f1b"
+
+    def _vocab_spec(self, names: tuple, shape: tuple):
+        return None  # replicated embeddings/head (see class docstring)
+
+    def value_and_grad(self, params, cfg: gpt.GPTConfig, batch, targets, rng=None):
+        """(loss, grads) for one global batch — the hook make_step_fns uses
+        instead of jax.value_and_grad (tpukit/train.py)."""
+        num_stages, num_micro = self.num_stages, self.num_microbatches
+        padded = self.padded_layers(cfg.num_layers)
+        per_stage = padded // num_stages
+        stack = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
+        if stack != padded:
+            raise ValueError(
+                f"stacked layer axis is {stack} but num_layers="
+                f"{cfg.num_layers} on {num_stages} stages needs {padded} — "
+                f"initialize through create_train_state(..., strategy=...)"
+            )
+        global_batch = batch["input_ids"].shape[0]
+        if global_batch % self.batch_divisor:
+            raise ValueError(
+                f"batch {global_batch} must divide into {num_micro} "
+                f"microbatches x {self.data_size} data shards"
+            )
+        micro = global_batch // num_micro
+        seq = batch["input_ids"].shape[1]
+
+        def split(x):
+            return x.reshape(num_micro, micro, *x.shape[1:])
+
+        inputs = split(batch["input_ids"])
+        positions = split(batch["position_ids"])
+        masks = split(batch["mask"])
+        tgts = split(targets)
+
+        data = "data" if "data" in self.mesh.axis_names else None
+        batch_spec = P(None, data)
+        layers = params["layers"]
+        rest = {k: v for k, v in params.items() if k != "layers"}
+        rest_zero_spec = jax.tree.map(lambda _: P(), rest)
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P("stage"), rest_zero_spec, batch_spec, batch_spec, batch_spec, batch_spec),
+            out_specs=(P(), P(), P("stage"), rest_zero_spec),
+            check_vma=False,
+        )
+        def schedule(local_layers, rest_params, inputs, positions, masks, tgts):
+            stage = jax.lax.axis_index("stage")
+            last = num_stages - 1
+            depth = 2 * num_stages  # ring depth: in-flight micros < 2S - 1
+            mb_local = inputs.shape[1]
+            # micro m forwards at stage s on tick m+s; the last stage
+            # backwards it the same tick; the cotangent reaches stage 0 at
+            # tick (m + S - 1) + (S - 1) — so the last backward retires at
+            # tick M + 2S - 3, i.e. M + 2S - 2 ticks total.
+            ticks = num_micro + 2 * num_stages - 2
+
+            if padded == cfg.num_layers:
+                active = None
+            else:
+                active = (
+                    stage * per_stage + jnp.arange(per_stage)
+                ) < cfg.num_layers
+
+            def key_for(mi):
+                if rng is None:
+                    return None
+                lin = stage * num_micro + mi
+                if data is not None:
+                    lin = lin * self.data_size + jax.lax.axis_index(data)
+                return jax.random.fold_in(rng, lin)
+
+            def stage_full(lp, rp, x, mask_in, mi):
+                """One stage's whole contribution for micro `mi`: ingest
+                (stage 0), trunk slice, and — on the last stage only —
+                head + CE. One function so the backward is ONE vjp."""
+                emb = gpt.apply_embeddings(rp, cfg, inputs[mi], positions[mi])
+                x_in = jnp.where(stage == 0, emb, x)
+                k = key_for(mi)
+                y = gpt.apply_decoder_layers(
+                    lp, cfg, x_in, mask_in,
+                    rng=k, deterministic=k is None, active=active,
+                )
+
+                def head(_):
+                    logits = gpt.apply_head(rp, cfg, y)
+                    return cross_entropy_sum(logits, tgts[mi])
+
+                def nohead(_):
+                    return jnp.float32(0), jnp.float32(0)
+
+                l_sum, cnt = jax.lax.cond(stage == last, head, nohead, None)
+                return y, l_sum, cnt
+
+            perm_f = [(i, i + 1) for i in range(num_stages - 1)]
+            perm_b = [(i + 1, i) for i in range(num_stages - 1)]
+
+            def tick(carry, _):
+                (x_fwd, mask_fwd, fvalid, dy_bwd, bvalid, xbuf, maskbuf,
+                 fcnt, bcnt, glp, grp, loss_sum, cnt_sum) = carry
+
+                # ---- forward unit: one primal step of micro `fcnt` ----
+                okf = jnp.where(stage == 0, fcnt < num_micro, fvalid)
+                mi_f = jnp.clip(fcnt, 0, num_micro - 1)
+                mask_in = jnp.where(stage == 0, masks[mi_f], mask_fwd)
+                y, l_sum, cnt = stage_full(
+                    local_layers, rest_params, x_fwd, mask_in, mi_f
+                )
+                at_last = stage == last
+                loss_sum = loss_sum + jnp.where(okf & at_last, l_sum, 0.0)
+                cnt_sum = cnt_sum + jnp.where(okf & at_last, cnt, 0.0)
+                slot = fcnt % depth
+                # gate the single written slot, not a select over the whole
+                # depth-2S buffer (keeps the carry update in place)
+                xbuf = xbuf.at[slot].set(jnp.where(okf, x_fwd, xbuf[slot]))
+                maskbuf = maskbuf.at[slot].set(
+                    jnp.where(okf, mask_in, maskbuf[slot])
+                )
+                fcnt = fcnt + okf.astype(fcnt.dtype)
+
+                # ---- backward unit: remat vjp of micro `bcnt` ----
+                # the last stage self-triggers (same tick as its forward)
+                okb = jnp.where(at_last, bcnt < fcnt, bvalid)
+                mi_b = jnp.clip(bcnt, 0, num_micro - 1)
+                slot_b = bcnt % depth
+                f = lambda lp, rp, x: stage_full(lp, rp, x, maskbuf[slot_b], mi_b)
+                (_, l_b, c_b), pull = jax.vjp(
+                    f, local_layers, rest_params, xbuf[slot_b]
+                )
+                dy_eff = jnp.where(okb & ~at_last, dy_bwd, 0).astype(
+                    cfg.compute_dtype
+                )
+                dl_eff = jnp.where(okb & at_last, 1.0, 0.0).astype(l_b.dtype)
+                dlp, drp, dx = pull((dy_eff, dl_eff, jnp.zeros_like(c_b)))
+                glp = jax.tree.map(jnp.add, glp, dlp)
+                grp = jax.tree.map(jnp.add, grp, drp)
+                bcnt = bcnt + okb.astype(bcnt.dtype)
+
+                # ---- ship: activations forward, cotangents backward ----
+                x_next = jax.lax.ppermute(y, "stage", perm_f)
+                mask_next = jax.lax.ppermute(mask_in, "stage", perm_f)
+                fvalid_next = jax.lax.ppermute(okf, "stage", perm_f)
+                dy_next = jax.lax.ppermute(dx, "stage", perm_b)
+                bvalid_next = jax.lax.ppermute(okb, "stage", perm_b)
+                return (
+                    (x_next, mask_next, fvalid_next, dy_next, bvalid_next,
+                     xbuf, maskbuf, fcnt, bcnt, glp, grp, loss_sum, cnt_sum),
+                    None,
+                )
+
+            zeros_x = jnp.zeros((mb_local, seq, cfg.dim), cfg.compute_dtype)
+            carry0 = (
+                zeros_x,
+                jnp.zeros((mb_local, seq), jnp.bool_),
+                jnp.bool_(False),
+                zeros_x,
+                jnp.bool_(False),
+                jnp.zeros((depth, mb_local, seq, cfg.dim), cfg.compute_dtype),
+                jnp.zeros((depth, mb_local, seq), jnp.bool_),
+                jnp.int32(0),
+                jnp.int32(0),
+                jax.tree.map(jnp.zeros_like, local_layers),
+                jax.tree.map(jnp.zeros_like, rest_params),
+                jnp.float32(0),
+                jnp.float32(0),
+            )
+            final_carry, _ = jax.lax.scan(tick, carry0, None, length=ticks)
+            glp, grp, loss_sum, cnt_sum = final_carry[-4:]
+
+            axes = tuple(self.mesh.axis_names)
+            loss_sum = jax.lax.psum(loss_sum, axes)
+            cnt_sum = jax.lax.psum(cnt_sum, axes)
+            # layer grads are stage-local; sum row-shards over `data`.
+            # embeddings/head grads live on stages 0/last only: sum over all.
+            if data is not None:
+                glp = jax.tree.map(lambda g: jax.lax.psum(g, data), glp)
+            grp = jax.tree.map(lambda g: jax.lax.psum(g, axes), grp)
+            return loss_sum, cnt_sum, glp, grp
+
+        loss_sum, count, glp, grp = schedule(
+            layers, rest, inputs, positions, masks, tgts
+        )
+        denom = jnp.maximum(count, 1.0)
+        grads = {**grp, "layers": glp}
+        grads = jax.tree.map(lambda g: (g / denom).astype(g.dtype), grads)
+        return loss_sum / denom, grads

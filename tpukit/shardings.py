@@ -159,6 +159,18 @@ class Strategy:
         accuracy = masked_accuracy(logits, targets) if with_accuracy else jnp.float32(0)
         return loss, accuracy
 
+    def value_and_grad(self, params, cfg: gpt.GPTConfig, batch, targets, rng=None):
+        """Loss and parameter gradients for one global batch — the training
+        half of the strategy contract (make_step_fns calls this). Default:
+        autodiff over `loss_fn`. Schedules that must build their gradient
+        explicitly (Pipeline1F1B's per-stage vjps) override it."""
+
+        def loss_of(p):
+            loss, _ = self.loss_fn(p, cfg, batch, targets, rng=rng)
+            return loss
+
+        return jax.value_and_grad(loss_of)(params)
+
     def describe(self) -> str:
         return f"{self.name} over mesh {dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}"
 

@@ -107,11 +107,11 @@ def make_step_fns(cfg: gpt.GPTConfig, optimizer, strategy: Strategy, state_shape
             else None
         )
 
-        def loss_of(params):
-            loss, _ = strategy.loss_fn(params, cfg, batch, targets, rng=rng)
-            return loss
-
-        loss, grads = jax.value_and_grad(loss_of)(state.params)
+        # autodiff over loss_fn by default; Pipeline1F1B overrides with its
+        # explicit per-stage-vjp schedule (see Strategy.value_and_grad)
+        loss, grads = strategy.value_and_grad(
+            state.params, cfg, batch, targets, rng=rng
+        )
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return (

@@ -160,13 +160,18 @@ def test_fsdp_two_process_sharded_checkpoint_resume(tmp_path):
 
 
 @pytest.mark.slow
-def test_pipeline_two_process_world(tmp_path):
+@pytest.mark.parametrize(
+    "schedule_args", [[], ["--schedule", "1f1b"]], ids=["gpipe", "1f1b"]
+)
+def test_pipeline_two_process_world(tmp_path, schedule_args):
     """Pipeline over 8 stages spanning 2 processes: batch rows are
     process-REPLICATED (make_global_batch's callback branch) while layer
-    shards and the ppermute schedule cross the host boundary."""
+    shards and the ppermute schedule cross the host boundary. The 1f1b
+    case additionally runs the BACKWARD ppermute chain and the explicit
+    per-stage vjp gradients across the boundary."""
     results = _launch_world(
         "main-pipe.py", tmp_path,
-        extra=["--num_layers", "8", "--microbatches", "8"],
+        extra=["--num_layers", "8", "--microbatches", "8"] + schedule_args,
     )
     assert abs(results[0]["eval_loss"] - results[1]["eval_loss"]) < 1e-5
     assert np.isfinite(results[0]["eval_loss"])

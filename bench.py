@@ -88,7 +88,7 @@ def main():
     # Secondary: long-context throughput (S=2048) through the Pallas flash
     # attention kernel — a regime where the materialized-mask attention the
     # reference uses (models/gpt.py:83-88) stops being viable.
-    long_tps = None
+    long_tps, long_err = None, None
     try:
         # batch 16/chip measured best on v5e with the fused head+CE path
         # (8 underfills the chip; 64 OOMs on trunk activations even with
@@ -119,14 +119,17 @@ def main():
             float(loss_l)
             best_l = min(best_l, time.perf_counter() - t0)
         long_tps = 8 * long_batch * long_seq / best_l / n_dev
-    except Exception as exc:  # stdout is reserved for the JSON line
+    except Exception as exc:  # stdout is reserved for the JSON line; the
+        # error ALSO lands in the JSON so a kernel regression cannot hide
+        # behind a clean rc=0 with null fields (VERDICT r4 #8)
+        long_err = repr(exc)
         print(f"long-context bench failed: {exc!r}", file=sys.stderr)
 
     # FSDP --cpu_offload proof (VERDICT r3 #6): run the donated train step
     # with params/opt state pinned to HOST memory on the real chip and
     # record that the state is still host-pinned afterwards — the positive
     # path that CPU tests can only fake (they assert the degrade warning).
-    offload_ok, offload_tps = None, None
+    offload_ok, offload_tps, offload_err = None, None, None
     try:
         from tpukit.mesh import create_mesh
         from tpukit.shardings import FSDP
@@ -154,7 +157,21 @@ def main():
             del state_o
     except Exception as exc:
         offload_ok = False
+        offload_err = repr(exc)
         print(f"fsdp cpu_offload probe failed: {exc!r}", file=sys.stderr)
+
+    # Ladder rungs (VERDICT r4 #1): single-chip measurements of the
+    # BASELINE configs 2-5 shapes at head_dim=64 — GPT-small/medium full,
+    # GPT-large/XL as the 16-layer stage slices DESIGN.md §2 profiles.
+    # Per-rung failures land as {"shape": ..., "error": ...} entries.
+    ladder = None
+    if n_dev == 1:  # rung batch sizes are tuned per chip
+        try:
+            from tools.bench_ladder import run_ladder
+
+            ladder = run_ladder(steps=6, windows=3)
+        except Exception as exc:
+            ladder = [{"shape": "ladder", "error": repr(exc)}]
 
     result = {
         "metric": "gpt_train_tokens_per_sec_per_chip",
@@ -164,8 +181,11 @@ def main():
         "mfu": round(mfu, 4) if mfu is not None else None,
         "tokens_per_sec_total": round(tps, 1),
         "long_context_s2048_tokens_per_sec_per_chip": round(long_tps, 1) if long_tps else None,
+        "long_context_error": long_err,
         "fsdp_cpu_offload_ok": offload_ok,
         "fsdp_cpu_offload_tokens_per_sec_per_chip": round(offload_tps, 1) if offload_tps else None,
+        "fsdp_cpu_offload_error": offload_err,
+        "ladder": ladder,
         "chips": n_dev,
         "device": jax.devices()[0].device_kind,
         "config": f"GPT-20M dim256 L8 seq256 bf16 batch{batch}, fused train step",

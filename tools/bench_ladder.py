@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Single-chip ladder benchmark: BASELINE configs 2-5 shapes (VERDICT r4 #1).
+
+BASELINE.md names GPT-small/medium/large/XL on v4-8/16/32 pods; pod hardware
+is unavailable here, so this measures the per-chip slice of each ladder rung
+on the one real chip — GPT-small and GPT-medium in full (they fit), and the
+16-layer stage slices of GPT-large/XL that docs/DESIGN.md §2 memory-profiles
+(what one pipeline stage of the 4/8-stage recipe would execute). All rungs
+use head_dim >= 64, the regime where the MXU contraction is not structurally
+capped (DESIGN.md §5: head_dim=32 pins attention matmuls at ~25% of peak).
+
+Usage: python tools/bench_ladder.py [--only NAME] [--batch N] [--steps N]
+Prints one JSON line per shape; `python bench.py` embeds the same
+measurements in the driver-facing JSON via bench.run_ladder().
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+LADDER = [
+    # name, dim, heads, head_dim, layers, seq, batch, remat, scan
+    # ("slice" = the 16-layer pipeline-stage slice DESIGN.md §2 profiles;
+    #  full GPT-large/XL state does not fit one 16 GB chip at f32+Adam).
+    # batch sizes swept on the real chip 2026-07-30: for every rung the
+    # largest fitting batch won (remat keeps temp flat, so bigger batches
+    # just amortize the weight traffic better).
+    ("gpt-small-dim768", 768, 12, 64, 12, 512, 64, False, False),
+    ("gpt-medium-dim1024", 1024, 16, 64, 24, 512, 32, True, True),
+    ("gpt-large-slice-dim1280", 1280, 20, 64, 16, 512, 32, True, True),
+    ("gpt-xl-slice-dim1600", 1600, 25, 64, 16, 512, 32, True, True),
+]
+
+
+def bench_shape(name, dim, heads, head_dim, layers, seq, batch, remat, scan,
+                steps=8, windows=3):
+    import jax
+    import jax.numpy as jnp
+
+    from tpukit.model import GPTConfig
+    from tpukit.profiling import peak_flops_per_chip, train_flops_per_token
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    cfg = GPTConfig(
+        dim=dim,
+        head_dim=head_dim,
+        heads=heads,
+        num_layers=layers,
+        vocab_size=50257,
+        max_position_embeddings=seq,
+        compute_dtype=jnp.bfloat16,
+        remat_layers=remat,
+        scan_layers=scan,
+    )
+    optimizer = make_optimizer(1e-4)
+    state = create_train_state(jax.random.PRNGKey(0), cfg, optimizer)
+    shapes = jax.eval_shape(lambda: state)
+    train_step, _, state_sharding = make_step_fns(cfg, optimizer, SingleDevice(), shapes)
+    state = jax.device_put(state, state_sharding)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+    model_batch = {
+        "input_ids": ids,
+        "position_ids": np.ascontiguousarray(
+            np.broadcast_to(np.arange(seq, dtype=np.int32), ids.shape)
+        ),
+        "mask": np.zeros_like(ids, dtype=bool),
+    }
+    targets = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    for _ in range(2):
+        state, loss = train_step(state, model_batch, targets)
+    float(loss)  # host sync (block_until_ready is a no-op on tunneled PJRT)
+
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = train_step(state, model_batch, targets)
+        float(loss)
+        best = min(best, time.perf_counter() - t0)
+
+    tps = steps * batch * seq / best
+    fpt = train_flops_per_token(cfg, seq)
+    peak = peak_flops_per_chip()
+    mfu = tps * fpt / peak if peak else None
+    del state
+    return {
+        "shape": name,
+        "config": f"dim{dim} hd{head_dim}x{heads} L{layers} seq{seq} b{batch}"
+                  + (" remat" if remat else ""),
+        "tokens_per_sec_per_chip": round(tps, 1),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "step_ms": round(best / steps * 1e3, 2),
+    }
+
+
+def run_ladder(steps=8, windows=3, only=None, batch=None):
+    """Run every rung, never raising: failures land in the record as
+    `error` (VERDICT r4 #8 — silent nulls hide regressions)."""
+    out = []
+    for name, dim, heads, hd, layers, seq, b, remat, scan in LADDER:
+        if only and only not in name:
+            continue
+        try:
+            out.append(bench_shape(name, dim, heads, hd, layers, seq,
+                                   batch or b, remat, scan, steps, windows))
+        except Exception as exc:
+            out.append({"shape": name, "error": repr(exc)})
+    return out
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--only", default=None)
+    p.add_argument("--batch", type=int, default=None)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--windows", type=int, default=3)
+    args = p.parse_args()
+    for rec in run_ladder(args.steps, args.windows, args.only, args.batch):
+        print(json.dumps(rec))
+        sys.stdout.flush()

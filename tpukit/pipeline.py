@@ -76,6 +76,22 @@ from tpukit.ops.layers import (
 from tpukit.shardings import Strategy
 
 
+def _vocab_slice_ce(norm_p, lm_kernel, y, targets, offset, v_local, cfg):
+    """Vocab-parallel head: layer_norm -> this stage's `v_local` logit
+    columns -> pad-column -1e9 mask -> collective CE over `stage`. The ONE
+    definition both pipeline schedules differentiate (GPipe via autodiff,
+    1F1B via an explicit jax.vjp); returns ((loss_sum, count), local_logits)
+    — the logits so the eval path can compute the global argmax accuracy."""
+    h = layer_norm(y, norm_p).astype(cfg.compute_dtype)
+    local_logits = linear(h, {"kernel": lm_kernel}, cfg.compute_dtype)
+    col = offset + jax.lax.broadcasted_iota(jnp.int32, (v_local,), 0)
+    local_logits = jnp.where(
+        col < cfg.vocab_size, local_logits,
+        jnp.asarray(-1e9, local_logits.dtype),
+    )
+    return vocab_parallel_ce(local_logits, targets, offset, "stage"), local_logits
+
+
 def _is_layers_path(path) -> bool:
     return any(
         isinstance(k, jax.tree_util.DictKey) and k.key == "layers" for k in path
@@ -384,25 +400,13 @@ class Pipeline(Strategy):
                         tgt_last = jax.lax.psum(
                             jnp.where(stage == last, tgt_in, 0), "stage"
                         )
-                        h = layer_norm(y_last, rest_params["norm_out"]).astype(
-                            cfg.compute_dtype
-                        )
-                        local_logits = linear(
-                            h, {"kernel": rest_params["lm_head"]["kernel"]},
-                            cfg.compute_dtype,
-                        )
                         offset = stage * v_local
-                        col = offset + jax.lax.broadcasted_iota(
-                            jnp.int32, (v_local,), 0
-                        )
-                        local_logits = jnp.where(
-                            col < cfg.vocab_size, local_logits,
-                            jnp.asarray(-1e9, local_logits.dtype),
-                        )
                         # no f32 [micro, S, V] anywhere: each stage holds V/S
                         # columns, CE backward is local (vocab_parallel_ce)
-                        l_sum, cnt = vocab_parallel_ce(
-                            local_logits, tgt_last, offset, "stage"
+                        (l_sum, cnt), local_logits = _vocab_slice_ce(
+                            rest_params["norm_out"],
+                            rest_params["lm_head"]["kernel"],
+                            y_last, tgt_last, offset, v_local, cfg,
                         )
                         if with_accuracy:
                             lf = local_logits.astype(jnp.float32)
@@ -494,7 +498,7 @@ class Pipeline1F1B(Pipeline):
     docs/DESIGN.md). Here the training gradient is built EXPLICITLY inside
     the tick loop: each tick, every stage runs one primal forward (sending
     its activation on) and one remat-style `jax.vjp` backward for the
-    oldest outstanding micro-batch (recomputing the stage forward from the
+    oldest outstanding micro-batch (recomputing the stage trunk from the
     saved stage INPUT, then transposing with the cotangent that arrived
     from the next stage). The scan itself is never differentiated, so each
     tick's internals are freed by XLA as it retires; the only persistent
@@ -507,23 +511,43 @@ class Pipeline1F1B(Pipeline):
     cotangent contributes exactly zero gradient), and per-stage counters
     pace the in-order micro-batch streams. The last stage triggers its own
     backward the same tick as its forward — the 1F1B interleave. Ticks:
-    num_micro + 2*num_stages (the bubble is the standard 1F1B one; the
-    win is memory, not bubble).
+    num_micro + 2*num_stages - 2 (the bubble is the standard 1F1B one;
+    the win is memory, not bubble).
 
-    Divergences from the parent (documented, deliberate):
-      - embeddings and lm_head stay REPLICATED across stages (no
-        vocab-over-stage sharding): the explicit-vjp schedule would need a
-        hand-written vocab-parallel CE transpose; use the GPipe schedule
-        when vocab sharding matters more than activation memory.
-      - eval reuses the parent's forward-only schedule (loss_fn).
-    Dropout keys derive from (stage, micro) — not the tick — so the
-    backward's recompute sees exactly the forward's mask.
+    Embeddings and lm_head shard their VOCAB dimension over `stage`
+    exactly like the parent (VERDICT r4 #4): the per-stage vjp covers only
+    the trunk (collective-free, so stages may replay *different* micros
+    the same tick), while the two vocab-collective computations run at
+    TICK level where their micro index is a uniform function of the tick —
+    stage 0 ingests micro `t`, the last stage's head+CE serves micro
+    `t-(S-1)` — so every stage participates in the same psum for the same
+    logical micro-batch and the collectives stay globally matched:
+
+      - ingest: each stage gathers its vocab slice of the lookup, one
+        psum assembles the embedding, stage 0 consumes it (the saved
+        stage input is POST-ingest, so the trunk replay never re-embeds);
+      - head: `jax.vjp` of (layer_norm -> local logits -> collective
+        vocab_parallel_ce) at micro `t-(S-1)`, whose primal output is the
+        loss contribution and whose pullback yields the lm_head/norm
+        grads plus the cotangent the last stage's trunk backward consumes
+        the SAME tick (the 1F1B self-trigger);
+      - the embedding-table transpose: the cotangent of stage 0's trunk
+        input IS d(embedding) for the micro stage 0 is retiring — also a
+        uniform function of the tick, `t-(2S-2)` — so one psum broadcasts
+        it and every stage scatter-adds its own vocab slice.
+
+    With the replicated fallback (padded vocab not divisible by the stage
+    count), ingest / head / table-transpose are instead `lax.cond`-gated
+    to the stages that need them (no collectives inside, so the
+    non-uniform predicate is safe) — stages no longer compute-and-discard
+    the embedding gather every tick (VERDICT r4 #5).
+
+    Eval reuses the parent's forward-only schedule (loss_fn). Dropout
+    keys derive from (stage, micro) — not the tick — so the backward's
+    recompute sees exactly the forward's mask.
     """
 
     name = "pipe-1f1b"
-
-    def _vocab_spec(self, names: tuple, shape: tuple):
-        return None  # replicated embeddings/head (see class docstring)
 
     def value_and_grad(self, params, cfg: gpt.GPTConfig, batch, targets, rng=None):
         """(loss, grads) for one global batch — the hook make_step_fns uses
@@ -559,13 +583,37 @@ class Pipeline1F1B(Pipeline):
         batch_spec = P(None, data)
         layers = params["layers"]
         rest = {k: v for k, v in params.items() if k != "layers"}
-        rest_zero_spec = jax.tree.map(lambda _: P(), rest)
+
+        v_pad = cfg.padded_vocab_size
+        # Same predicate as state_sharding/loss_fn, so the in/out specs
+        # below always match the arrays' actual placement.
+        shard_vocab = (
+            self._vocab_spec(
+                ("embeddings", "token"), rest["embeddings"]["token"].shape
+            )
+            is not None
+        )
+        v_local = v_pad // num_stages if shard_vocab else v_pad
+
+        def rest_spec(path, leaf):
+            vocab = self._vocab_spec(_path_names(path), leaf.shape)
+            return vocab if vocab is not None else P()
+
+        rest_specs = jax.tree_util.tree_map_with_path(rest_spec, rest)
+        # Gradients of vocab-sharded leaves stay stage-local (each stage
+        # owns its slice); replicated leaves' contributions are gated to
+        # one stage and psum'd. Derived from rest_specs (single source of
+        # truth) — decided OUTSIDE shard_map, which needs global shapes.
+        rest_sharded = jax.tree.map(
+            lambda spec: spec != P(), rest_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
 
         @partial(
             shard_map,
             mesh=self.mesh,
-            in_specs=(P("stage"), rest_zero_spec, batch_spec, batch_spec, batch_spec, batch_spec),
-            out_specs=(P(), P(), P("stage"), rest_zero_spec),
+            in_specs=(P("stage"), rest_specs, batch_spec, batch_spec, batch_spec, batch_spec),
+            out_specs=(P(), P(), P("stage"), rest_specs),
             check_vma=False,
         )
         def schedule(local_layers, rest_params, inputs, positions, masks, tgts):
@@ -594,71 +642,258 @@ class Pipeline1F1B(Pipeline):
                     lin = lin * self.data_size + jax.lax.axis_index(data)
                 return jax.random.fold_in(rng, lin)
 
-            def stage_full(lp, rp, x, mask_in, mi):
-                """One stage's whole contribution for micro `mi`: ingest
-                (stage 0), trunk slice, and — on the last stage only —
-                head + CE. One function so the backward is ONE vjp."""
-                emb = gpt.apply_embeddings(rp, cfg, inputs[mi], positions[mi])
-                x_in = jnp.where(stage == 0, emb, x)
+            def stage_trunk(lp, x_in, mask_in, mi):
+                """One stage's trunk slice for micro `mi` — collective-free,
+                so its vjp can replay a DIFFERENT micro per stage."""
                 k = key_for(mi)
-                y = gpt.apply_decoder_layers(
+                return gpt.apply_decoder_layers(
                     lp, cfg, x_in, mask_in,
                     rng=k, deterministic=k is None, active=active,
                 )
 
-                def head(_):
-                    logits = gpt.apply_head(rp, cfg, y)
-                    return cross_entropy_sum(logits, tgts[mi])
+            def sharded_ingest(mi):
+                """Distributed lookup: every stage contributes its vocab
+                slice, one exact psum assembles the embedding. `mi` must be
+                tick-uniform (the psum is collective)."""
+                rel = inputs[mi] - stage * v_local
+                ok = (rel >= 0) & (rel < v_local)
+                part = jnp.where(
+                    ok[..., None],
+                    jnp.take(
+                        rest_params["embeddings"]["token"],
+                        jnp.where(ok, rel, 0),
+                        axis=0,
+                    ),
+                    0.0,
+                )
+                emb = jax.lax.psum(part, "stage") + jnp.take(
+                    rest_params["embeddings"]["position"], positions[mi], axis=0
+                )
+                return emb.astype(cfg.compute_dtype)
 
-                def nohead(_):
-                    return jnp.float32(0), jnp.float32(0)
+            def zeros_rest():
+                return jax.tree.map(jnp.zeros_like, rest_params)
 
-                l_sum, cnt = jax.lax.cond(stage == last, head, nohead, None)
-                return y, l_sum, cnt
+            def add_emb_grads(grp, d_tok, d_pos):
+                return {
+                    **grp,
+                    "embeddings": {
+                        "token": grp["embeddings"]["token"] + d_tok,
+                        "position": grp["embeddings"]["position"] + d_pos,
+                    },
+                }
 
             perm_f = [(i, i + 1) for i in range(num_stages - 1)]
             perm_b = [(i + 1, i) for i in range(num_stages - 1)]
 
-            def tick(carry, _):
+            def tick(carry, t):
                 (x_fwd, mask_fwd, fvalid, dy_bwd, bvalid, xbuf, maskbuf,
                  fcnt, bcnt, glp, grp, loss_sum, cnt_sum) = carry
-
-                # ---- forward unit: one primal step of micro `fcnt` ----
-                okf = jnp.where(stage == 0, fcnt < num_micro, fvalid)
-                mi_f = jnp.clip(fcnt, 0, num_micro - 1)
-                mask_in = jnp.where(stage == 0, masks[mi_f], mask_fwd)
-                y, l_sum, cnt = stage_full(
-                    local_layers, rest_params, x_fwd, mask_in, mi_f
-                )
+                is0 = stage == 0
                 at_last = stage == last
-                loss_sum = loss_sum + jnp.where(okf & at_last, l_sum, 0.0)
-                cnt_sum = cnt_sum + jnp.where(okf & at_last, cnt, 0.0)
+
+                # ---- forward unit: one primal trunk step of micro `fcnt`.
+                # Stage 0 ingests through the embeddings; the saved stage
+                # input is POST-ingest, so backward replay never re-embeds.
+                okf = jnp.where(is0, fcnt < num_micro, fvalid)
+                mi_f = jnp.clip(fcnt, 0, num_micro - 1)
+                mask_in = jnp.where(is0, masks[mi_f], mask_fwd)
+                if shard_vocab:
+                    # stage 0's forward micro is `t` (its fcnt advances every
+                    # tick until exhausted), a tick-uniform index — so every
+                    # stage participates in the ingest psum for the same
+                    # logical micro. The predicate is tick-uniform too, so
+                    # the 2S-2 drain ticks skip the gather + psum entirely
+                    # (collectives inside a uniform cond stay matched).
+                    x_eff = jax.lax.cond(
+                        t < num_micro,
+                        lambda: jnp.where(is0, sharded_ingest(t), x_fwd),
+                        lambda: x_fwd,
+                    )
+                else:
+                    x_eff = jax.lax.cond(
+                        is0,
+                        lambda: gpt.apply_embeddings(
+                            rest_params, cfg, inputs[mi_f], positions[mi_f]
+                        ),
+                        lambda: x_fwd,
+                    )
+                y = stage_trunk(local_layers, x_eff, mask_in, mi_f)
                 slot = fcnt % depth
                 # gate the single written slot, not a select over the whole
                 # depth-2S buffer (keeps the carry update in place)
-                xbuf = xbuf.at[slot].set(jnp.where(okf, x_fwd, xbuf[slot]))
+                xbuf = xbuf.at[slot].set(jnp.where(okf, x_eff, xbuf[slot]))
                 maskbuf = maskbuf.at[slot].set(
                     jnp.where(okf, mask_in, maskbuf[slot])
                 )
                 fcnt = fcnt + okf.astype(fcnt.dtype)
 
-                # ---- backward unit: remat vjp of micro `bcnt` ----
-                # the last stage self-triggers (same tick as its forward)
-                okb = jnp.where(at_last, bcnt < fcnt, bvalid)
+                # ---- head + CE for the micro reaching the last stage this
+                # tick. Its primal output is the loss contribution; its
+                # pullback yields the head grads AND the trunk cotangent the
+                # last stage consumes the same tick (the 1F1B self-trigger).
+                okb_last = bcnt < fcnt  # last stage's backward validity
+                if shard_vocab:
+                    # tick-uniform micro t-(S-1): collectives inside match.
+                    idx_h = t - (num_stages - 1)
+                    okh = (idx_h >= 0) & (idx_h < num_micro)
+                    mi_h = jnp.clip(idx_h, 0, num_micro - 1)
+
+                    def head_block(_):
+                        y_b = jax.lax.psum(
+                            jnp.where(at_last, y, jnp.zeros_like(y)), "stage"
+                        )
+                        tgt_h = tgts[mi_h]
+                        offset = stage * v_local
+
+                        def f(norm_p, lm_k, yy):
+                            (l, c), _ = _vocab_slice_ce(
+                                norm_p, lm_k, yy, tgt_h, offset, v_local, cfg
+                            )
+                            return l, c
+
+                        (l_s, c_s), pull_h = jax.vjp(
+                            f,
+                            rest_params["norm_out"],
+                            rest_params["lm_head"]["kernel"],
+                            y_b,
+                        )
+                        # vocab_parallel_ce's backward psums the incoming
+                        # cotangent over `stage`; gating it to stage 0 makes
+                        # that psum recover exactly 1.
+                        dl = jnp.where(is0, 1.0, 0.0).astype(jnp.float32)
+                        dnorm, dlm, dyb = pull_h((dl, jnp.float32(0)))
+                        # f consumed the broadcast y on every stage, so the
+                        # true cotangent at the last stage's y is the sum of
+                        # every stage's dyb (the psum_bcast transpose).
+                        dy_l = jax.lax.psum(dyb, "stage")
+                        return l_s, c_s, dnorm, dlm, dy_l
+
+                    def no_head(_):
+                        return (
+                            jnp.float32(0), jnp.float32(0),
+                            jax.tree.map(jnp.zeros_like, rest_params["norm_out"]),
+                            jnp.zeros_like(rest_params["lm_head"]["kernel"]),
+                            jnp.zeros_like(y),
+                        )
+
+                    l_s, c_s, dnorm, dlm, dy_head = jax.lax.cond(
+                        okh, head_block, no_head, None
+                    )
+                    # l_s/c_s are replicated (collective CE); accumulate on
+                    # stage 0 only so the final all-axes psum counts them once
+                    # per data shard.
+                    loss_sum = loss_sum + jnp.where(okh & is0, l_s, 0.0)
+                    cnt_sum = cnt_sum + jnp.where(okh & is0, c_s, 0.0)
+                    grp = {
+                        **grp,
+                        "norm_out": jax.tree.map(
+                            jnp.add, grp["norm_out"], dnorm
+                        ),
+                        "lm_head": {
+                            "kernel": grp["lm_head"]["kernel"] + dlm
+                        },
+                    }
+                else:
+                    mi_b_last = jnp.clip(bcnt, 0, num_micro - 1)
+
+                    def head_block(_):
+                        def f(rp, yy):
+                            logits = gpt.apply_head(rp, cfg, yy)
+                            return cross_entropy_sum(logits, tgts[mi_b_last])
+
+                        (l_s, c_s), pull_h = jax.vjp(f, rest_params, y)
+                        dl = jnp.where(okb_last, 1.0, 0.0).astype(jnp.float32)
+                        drp, dy_l = pull_h((dl, jnp.float32(0)))
+                        return (
+                            jnp.where(okb_last, l_s, 0.0),
+                            jnp.where(okb_last, c_s, 0.0),
+                            drp, dy_l,
+                        )
+
+                    def no_head(_):
+                        return (
+                            jnp.float32(0), jnp.float32(0),
+                            zeros_rest(), jnp.zeros_like(y),
+                        )
+
+                    # no collectives inside -> the non-uniform predicate is
+                    # safe; only the last stage pays the head compute.
+                    l_s, c_s, drp_head, dy_head = jax.lax.cond(
+                        at_last, head_block, no_head, None
+                    )
+                    loss_sum = loss_sum + l_s
+                    cnt_sum = cnt_sum + c_s
+                    grp = jax.tree.map(jnp.add, grp, drp_head)
+
+                # ---- backward unit: remat vjp of the trunk for micro
+                # `bcnt` (the last stage self-triggers: its cotangent is
+                # dy_head from this very tick).
+                okb = jnp.where(at_last, okb_last, bvalid)
                 mi_b = jnp.clip(bcnt, 0, num_micro - 1)
                 slot_b = bcnt % depth
-                f = lambda lp, rp, x: stage_full(lp, rp, x, maskbuf[slot_b], mi_b)
-                (_, l_b, c_b), pull = jax.vjp(
-                    f, local_layers, rest_params, xbuf[slot_b]
-                )
-                dy_eff = jnp.where(okb & ~at_last, dy_bwd, 0).astype(
-                    cfg.compute_dtype
-                )
-                dl_eff = jnp.where(okb & at_last, 1.0, 0.0).astype(l_b.dtype)
-                dlp, drp, dx = pull((dy_eff, dl_eff, jnp.zeros_like(c_b)))
+                f = lambda lp, x: stage_trunk(lp, x, maskbuf[slot_b], mi_b)
+                _, pull = jax.vjp(f, local_layers, xbuf[slot_b])
+                dy_eff = jnp.where(
+                    okb, jnp.where(at_last, dy_head, dy_bwd), 0
+                ).astype(cfg.compute_dtype)
+                dlp, dx = pull(dy_eff)
                 glp = jax.tree.map(jnp.add, glp, dlp)
-                grp = jax.tree.map(jnp.add, grp, drp)
                 bcnt = bcnt + okb.astype(bcnt.dtype)
+
+                # ---- embedding-table transpose: stage 0's trunk-input
+                # cotangent IS d(embedding) for the micro stage 0 retires.
+                dx_gated = jnp.where(okb & is0, dx, 0).astype(jnp.float32)
+                if shard_vocab:
+                    # stage 0 retires micro t-(2S-2) — tick-uniform, so one
+                    # psum broadcasts d(emb) and every stage scatter-adds its
+                    # own vocab slice of the table gradient.
+                    idx_b0 = t - (2 * num_stages - 2)
+                    mi_e = jnp.clip(idx_b0, 0, num_micro - 1)
+                    d_emb = jax.lax.psum(dx_gated, "stage")
+                    rel = inputs[mi_e] - stage * v_local
+                    ok = (rel >= 0) & (rel < v_local)
+                    d_tok = (
+                        jnp.zeros_like(grp["embeddings"]["token"])
+                        .at[jnp.where(ok, rel, v_local)]
+                        .add(
+                            jnp.where(ok[..., None], d_emb, 0.0),
+                            mode="drop",
+                        )
+                    )
+                    d_pos = (
+                        jnp.zeros_like(grp["embeddings"]["position"])
+                        .at[positions[mi_e]]
+                        .add(d_emb)
+                    )
+                    # position table is replicated (final psum over stage):
+                    # count its contribution once.
+                    grp = add_emb_grads(
+                        grp, d_tok, jnp.where(is0, d_pos, 0.0)
+                    )
+                else:
+
+                    def emb_bwd(_):
+                        d_tok = (
+                            jnp.zeros_like(grp["embeddings"]["token"])
+                            .at[inputs[mi_b]]
+                            .add(dx_gated)
+                        )
+                        d_pos = (
+                            jnp.zeros_like(grp["embeddings"]["position"])
+                            .at[positions[mi_b]]
+                            .add(dx_gated)
+                        )
+                        return d_tok, d_pos
+
+                    def no_emb(_):
+                        return (
+                            jnp.zeros_like(grp["embeddings"]["token"]),
+                            jnp.zeros_like(grp["embeddings"]["position"]),
+                        )
+
+                    d_tok, d_pos = jax.lax.cond(is0, emb_bwd, no_emb, None)
+                    grp = add_emb_grads(grp, d_tok, d_pos)
 
                 # ---- ship: activations forward, cotangents backward ----
                 x_next = jax.lax.ppermute(y, "stage", perm_f)
@@ -688,17 +923,25 @@ class Pipeline1F1B(Pipeline):
                 jnp.float32(0),
                 jnp.float32(0),
             )
-            final_carry, _ = jax.lax.scan(tick, carry0, None, length=ticks)
+            final_carry, _ = jax.lax.scan(tick, carry0, jnp.arange(ticks))
             glp, grp, loss_sum, cnt_sum = final_carry[-4:]
 
             axes = tuple(self.mesh.axis_names)
             loss_sum = jax.lax.psum(loss_sum, axes)
             cnt_sum = jax.lax.psum(cnt_sum, axes)
             # layer grads are stage-local; sum row-shards over `data`.
-            # embeddings/head grads live on stages 0/last only: sum over all.
+            # Vocab-sharded leaves (token table / lm_head kernel) likewise
+            # stay stage-local; replicated rest leaves were gated to a
+            # single stage's contribution and psum over every axis.
             if data is not None:
                 glp = jax.tree.map(lambda g: jax.lax.psum(g, data), glp)
-            grp = jax.tree.map(lambda g: jax.lax.psum(g, axes), grp)
+
+            def reduce_rest(g, is_sharded):
+                if is_sharded:
+                    return jax.lax.psum(g, data) if data is not None else g
+                return jax.lax.psum(g, axes)
+
+            grp = jax.tree.map(reduce_rest, grp, rest_sharded)
             return loss_sum, cnt_sum, glp, grp
 
         loss_sum, count, glp, grp = schedule(

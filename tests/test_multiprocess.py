@@ -160,6 +160,66 @@ def test_fsdp_two_process_sharded_checkpoint_resume(tmp_path):
 
 
 @pytest.mark.slow
+def test_ddp_two_process_world_matches_single(tmp_path):
+    """DDP across 2 processes: the multi-host branch of DataParallel — each
+    process feeds its addressable rank shard, XLA's grad all-reduce crosses
+    the process boundary. Eval loss agrees across ranks exactly and matches
+    the single-process 8-device world (same global row sets)."""
+    mp_dir = tmp_path / "mp"
+    mp_dir.mkdir()
+    results = _launch_world("main-ddp.py", mp_dir)
+    assert abs(results[0]["eval_loss"] - results[1]["eval_loss"]) < 1e-5
+    assert np.isfinite(results[0]["eval_loss"])
+
+    single_dir = tmp_path / "single"
+    single_dir.mkdir()
+    ref = _single_world_loss("main-ddp.py", single_dir)
+    assert abs(results[0]["eval_loss"] - ref) < 5e-2
+
+
+@pytest.mark.slow
+def test_tp_two_process_world_matches_single(tmp_path):
+    """Tensor parallel across 2 processes: the (data=2, model=4) grid spans
+    the host boundary, so the per-layer Megatron all-reduces (after
+    attention and after the MLP) cross processes, as do the vocab-sharded
+    embedding/head gathers."""
+    mp_dir = tmp_path / "mp"
+    mp_dir.mkdir()
+    results = _launch_world("main-tp.py", mp_dir)
+    assert abs(results[0]["eval_loss"] - results[1]["eval_loss"]) < 1e-5
+    assert np.isfinite(results[0]["eval_loss"])
+
+    single_dir = tmp_path / "single"
+    single_dir.mkdir()
+    ref = _single_world_loss("main-tp.py", single_dir)
+    assert abs(results[0]["eval_loss"] - ref) < 5e-2
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "cp_args",
+    [[], ["--cp_attention", "ulysses", "--heads", "8"]],
+    ids=["ring", "ulysses"],
+)
+def test_cp_two_process_world_matches_single(tmp_path, cp_args):
+    """Context parallelism across 2 processes: the seq=8 mesh axis spans the
+    host boundary, so the ring's K/V ppermute hops (or Ulysses' two
+    all_to_alls) run over the cross-process transport. Ulysses needs
+    heads % 8 == 0, hence the head override (head count changes the model,
+    so its single-world reference uses the same override)."""
+    mp_dir = tmp_path / "mp"
+    mp_dir.mkdir()
+    results = _launch_world("main-ring.py", mp_dir, extra=cp_args)
+    assert abs(results[0]["eval_loss"] - results[1]["eval_loss"]) < 1e-5
+    assert np.isfinite(results[0]["eval_loss"])
+
+    single_dir = tmp_path / "single"
+    single_dir.mkdir()
+    ref = _single_world_loss("main-ring.py", single_dir, extra=cp_args)
+    assert abs(results[0]["eval_loss"] - ref) < 5e-2
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "schedule_args", [[], ["--schedule", "1f1b"]], ids=["gpipe", "1f1b"]
 )

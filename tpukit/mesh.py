@@ -48,10 +48,22 @@ def initialize_runtime() -> None:
         kwargs = {}
         if explicit:
             kwargs["coordinator_address"] = os.environ["JAX_COORDINATOR_ADDRESS"]
-            if os.environ.get("JAX_NUM_PROCESSES"):
-                kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
-            if os.environ.get("JAX_PROCESS_ID"):
-                kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+            n_procs = os.environ.get("JAX_NUM_PROCESSES")
+            proc_id = os.environ.get("JAX_PROCESS_ID")
+            # The pair must be set (or unset) together: passing only one to
+            # jax.distributed.initialize fails with an opaque error deep in
+            # JAX instead of naming the missing variable (ADVICE r4).
+            if bool(n_procs) != bool(proc_id):
+                missing = "JAX_PROCESS_ID" if n_procs else "JAX_NUM_PROCESSES"
+                raise RuntimeError(
+                    f"JAX_COORDINATOR_ADDRESS is set but only one of the "
+                    f"process-identity pair is: {missing} is missing. Set "
+                    "both JAX_NUM_PROCESSES and JAX_PROCESS_ID (or neither, "
+                    "to let a launcher/cluster environment supply them)."
+                )
+            if n_procs:
+                kwargs["num_processes"] = int(n_procs)
+                kwargs["process_id"] = int(proc_id)
         try:
             jax.distributed.initialize(**kwargs)
         except Exception as exc:

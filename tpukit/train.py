@@ -218,7 +218,11 @@ def replicated_params(strategy: Strategy, state: TrainState):
         l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(state.params)
     )
     if total > limit:
-        return strategy.to_compute(state).params
+        # Move ONLY the params subtree into device memory: to_compute maps
+        # leaf-wise, and running it on the whole TrainState would transiently
+        # pull both Adam moments (~3x params) into HBM for a decode that
+        # never reads them (ADVICE r4).
+        return strategy.to_compute(state.params)
     return _replicator(strategy.mesh)(state.params)
 
 

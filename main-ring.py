@@ -28,7 +28,12 @@ from tpukit.train import fit
 
 def main(argv=None):
     flags = parse_flags(argv, cp_attention=True)
-    return fit(flags, ContextParallel(attention=flags.cp_attention))
+    # host_permute: fit() applies the zigzag layout permutation on the host
+    # numpy batch (strategy.host_batch_fn) instead of an in-jit gather that
+    # GSPMD turns into a per-step cross-shard reshard (ADVICE r4).
+    return fit(
+        flags, ContextParallel(attention=flags.cp_attention, host_permute=True)
+    )
 
 
 if __name__ == "__main__":

@@ -51,6 +51,7 @@ def main() -> None:
         "global_devices": len(jax.devices()),
         "eval_loss": float(result.metrics["eval"]["loss"]),
         "eval_accuracy": float(result.metrics["eval"]["accuracy"]),
+        "train_tokens": int(result.metrics["train_tokens"]),
         "step": int(jax.device_get(result.state.step)),
         "checkpoint": str(result.checkpoint_path),
         "checkpoint_exists": result.checkpoint_path is not None

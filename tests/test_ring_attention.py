@@ -248,6 +248,34 @@ def test_cp_matches_single(cfg, batch):
     )
 
 
+def test_cp_host_permuted_matches_injit(cfg, batch):
+    """ADVICE r4: the zigzag permutation applied host-side (host_batch_fn,
+    what fit() does — no per-step reshard collective) must produce exactly
+    the in-jit permute's loss and parameter update."""
+    model_batch, targets = batch
+    # fit()'s convention: the model consumes sequence_length - 1 tokens
+    cfg33 = cfg.replace(max_position_embeddings=SEQ + 1)
+
+    injit = _one_step(
+        ContextParallel(create_mesh({"seq": 8})), cfg33, model_batch, targets
+    )
+
+    host_strategy = ContextParallel(create_mesh({"seq": 8}), host_permute=True)
+    permute = host_strategy.host_batch_fn(cfg33)
+    assert permute is not None  # 32 % (2*8) == 0 -> zigzag active
+    # without the explicit opt-in, no permute fn and loss_fn permutes in-jit
+    assert ContextParallel(create_mesh({"seq": 8})).host_batch_fn(cfg33) is None
+    h_batch, h_targets = permute(model_batch, targets)
+    hosted = _one_step(host_strategy, cfg33, h_batch, h_targets)
+
+    assert abs(hosted[1] - injit[1]) < 1e-6
+    assert abs(hosted[2] - injit[2]) < 1e-6
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+        hosted[0], injit[0],
+    )
+
+
 def test_cp_ulysses_matches_single(cfg, batch):
     model_batch, targets = batch
     ref = _one_step(SingleDevice(), cfg, model_batch, targets)

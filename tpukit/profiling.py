@@ -87,6 +87,12 @@ class MFUMeter:
         self._elapsed = now - self._t0
 
     @property
+    def total_tokens(self) -> int:
+        """Global real tokens accumulated (timed steps only — the first
+        update starts the clock and is not counted)."""
+        return self._tokens
+
+    @property
     def tokens_per_sec(self) -> float | None:
         if self._steps == 0 or self._elapsed == 0:
             return None

@@ -93,6 +93,53 @@ def _decode_loop_cached(params, cfg: gpt.GPTConfig, buf, prompt_len: int, max_ne
     return buf, cur
 
 
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "eos_id"))
+def _decode_loop_batch(params, cfg: gpt.GPTConfig, buf, prompt_lens, max_new_tokens: int, eos_id: int):
+    """Batched twin of `_decode_loop`: N prompts of (traced) per-row lengths
+    decode in ONE jitted while_loop — one compile and one decode for the
+    whole prompt set instead of a compile + serial decode per prompt
+    (VERDICT r4 #7: the per-epoch qualitative eval stalls a pod N times
+    otherwise). Rows carry independent cursors/EOS flags; causality makes
+    each row's logits at `cur-1` depend only on its own written prefix, so
+    the output is token-for-token the serial decode's
+    (tests/test_sampling.py parity). Returns (buf [N, W], lengths [N])."""
+    n, total = buf.shape
+    position_ids = jnp.broadcast_to(jnp.arange(total, dtype=jnp.int32), buf.shape)
+    limits = jnp.minimum(prompt_lens + max_new_tokens, total)
+    rows = jnp.arange(n)
+
+    def cond(carry):
+        _, cur, done = carry
+        return jnp.any(~done & (cur < limits))
+
+    def body(carry):
+        buf, cur, done = carry
+        logits = gpt.forward(params, cfg, buf, position_ids)
+        read = jnp.clip(cur - 1, 0, total - 1)
+        # gather the one [N, V] row set first, THEN cast — like the serial
+        # loop; casting the whole [N, W, V] tensor would be W x the traffic
+        last = jnp.take_along_axis(logits, read[:, None, None], axis=1)[
+            :, 0
+        ].astype(jnp.float32)
+        next_token = jnp.argmax(last, axis=-1).astype(buf.dtype)
+        active = ~done & (cur < limits)
+        hit_eos = next_token == eos_id
+        # stop BEFORE appending on EOS (reference utils.py:67-68)
+        append = active & ~hit_eos
+        write = jnp.clip(cur, 0, total - 1)
+        kept = buf[rows, write]
+        buf = buf.at[rows, write].set(jnp.where(append, next_token, kept))
+        cur = jnp.where(append, cur + 1, cur)
+        done = done | (active & hit_eos)
+        return buf, cur, done
+
+    buf, cur, _ = jax.lax.while_loop(
+        cond, body,
+        (buf, prompt_lens.astype(jnp.int32), jnp.zeros((n,), jnp.bool_)),
+    )
+    return buf, cur
+
+
 def _replicate_like(params, buf):
     """Place the decode buffer replicated on the params' mesh. Plain
     `jnp.asarray` would commit it to a single device, which is invalid for
@@ -153,3 +200,44 @@ def generate(
     )
     out_ids = np.asarray(buf)[0, : int(length)]
     return tokenizer.decode(out_ids, skip_special_tokens=True)
+
+
+def generate_batch(
+    params,
+    cfg: gpt.GPTConfig,
+    prompts: list[str],
+    tokenizer,
+    max_new_tokens: int = 20,
+) -> list[str]:
+    """Greedy-decode continuations of every prompt in ONE jitted call.
+
+    Prompts are right-padded into a common `[N, max_prompt + new]` buffer
+    with per-row (traced) lengths, so any prompt set of the same max length
+    reuses one compiled program. Output is token-for-token identical to
+    `generate` called per prompt (tests/test_sampling.py)."""
+    if not prompts:
+        return []
+    max_prompt = min(256, cfg.max_position_embeddings - max_new_tokens)
+    if max_prompt < 1:
+        raise ValueError(
+            f"max_new_tokens={max_new_tokens} leaves no room for a prompt "
+            f"within max_position_embeddings={cfg.max_position_embeddings}"
+        )
+    encoded = tokenizer(list(prompts), truncation=True, max_length=max_prompt)
+    ids = [np.asarray(row, dtype=np.int32) for row in encoded["input_ids"]]
+    lens = np.asarray([r.shape[0] for r in ids], dtype=np.int32)
+
+    buf = np.zeros((len(ids), int(lens.max()) + max_new_tokens), dtype=np.int32)
+    for r, row in enumerate(ids):
+        buf[r, : row.shape[0]] = row
+
+    buf, lengths = _decode_loop_batch(
+        params, cfg, _replicate_like(params, buf),
+        _replicate_like(params, lens), max_new_tokens,
+        int(tokenizer.eos_token_id),
+    )
+    buf, lengths = np.asarray(buf), np.asarray(lengths)
+    return [
+        tokenizer.decode(buf[r, : int(lengths[r])], skip_special_tokens=True)
+        for r in range(buf.shape[0])
+    ]

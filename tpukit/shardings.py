@@ -97,6 +97,14 @@ class Strategy:
         Pipeline.prepare_params)."""
         return params
 
+    def host_batch_fn(self, cfg: gpt.GPTConfig):
+        """Optional host-side per-batch transform, applied by the trainer to
+        the numpy batch BEFORE device placement. None (default) for every
+        strategy except ContextParallel, whose zigzag sequence permutation
+        would otherwise be a cross-shard reshard collective inside every
+        jitted step (ADVICE r4)."""
+        return None
+
     def validate_config(self, cfg: gpt.GPTConfig) -> None:
         """Raise a clear error before any tracing when the model shape cannot
         map onto this strategy's mesh (divisibility constraints)."""
@@ -284,13 +292,25 @@ class ContextParallel(Strategy):
 
     name = "cp"
 
-    def __init__(self, mesh: Mesh | None = None, attention: str = "ring"):
+    def __init__(
+        self, mesh: Mesh | None = None, attention: str = "ring",
+        host_permute: bool = False,
+    ):
         """`attention` picks the sequence-parallel schedule:
         "ring" (default) — K/V ppermute hops, zigzag-balanced, works for
         any head count; "ulysses" — two all_to_alls re-partition to
         head-sharding and run full-sequence flash attention locally
-        (needs heads % seq_shards == 0). See tpukit/ring_attention.py."""
+        (needs heads % seq_shards == 0). See tpukit/ring_attention.py.
+
+        `host_permute=True` declares that the CALLER applies the zigzag
+        permutation host-side (via the fn `host_batch_fn` returns, as
+        fit() does) and loss_fn must NOT re-permute in-jit — in-jit the
+        same gather on the seq-sharded batch is a cross-shard reshard
+        collective every step (ADVICE r4). With it set, every loss_fn
+        call must receive host-permuted batches whenever zigzag is
+        active."""
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"seq": -1})
+        self.host_permute = host_permute
         if "seq" not in self.mesh.axis_names:
             raise ValueError("ContextParallel needs a 'seq' mesh axis")
         if attention not in ("ring", "ulysses"):
@@ -322,6 +342,42 @@ class ContextParallel(Strategy):
                 f"sequence shards (or use attention='ring')"
             )
 
+    def _use_zigzag(self, seq_len: int) -> bool:
+        """Zigzag layout (causal load balance — tpukit/ring_attention.py):
+        permute the sequence so each shard holds one early + one late
+        chunk; every per-token computation (embeddings, MLPs, CE sums) is
+        permutation-invariant, so only the ring schedule needs to know.
+        Falls back to the contiguous ring when 2*P doesn't divide S.
+        The ulysses schedule keeps the contiguous layout (its local
+        attention sees the full gathered sequence)."""
+        return (
+            self.attention == "ring"
+            and seq_len % (2 * self.seq_size) == 0
+            and self.seq_size > 1
+        )
+
+    def host_batch_fn(self, cfg: gpt.GPTConfig):
+        """The zigzag permutation as a HOST-side numpy transform, applied
+        before device placement (ADVICE r4: in-jit, the same gather on the
+        globally seq-sharded batch makes GSPMD insert a cross-shard reshard
+        of four token-sized arrays every train/eval step). Only returned
+        when the strategy was constructed with `host_permute=True` — the
+        explicit contract that loss_fn will receive pre-permuted batches."""
+        seq_len = cfg.max_position_embeddings - 1  # model seq after the shift
+        if not (self.host_permute and self._use_zigzag(seq_len)):
+            return None
+        from tpukit.ring_attention import zigzag_order
+
+        order = zigzag_order(seq_len, self.seq_size)
+
+        def permute(model_batch, targets):
+            return (
+                {key: val[:, order] for key, val in model_batch.items()},
+                targets[:, order],
+            )
+
+        return permute
+
     def loss_fn(
         self, params, cfg: gpt.GPTConfig, batch, targets,
         with_accuracy: bool = False, rng=None,
@@ -332,19 +388,8 @@ class ContextParallel(Strategy):
                 f"sequence length {seq_len} must divide over {self.seq_size} "
                 f"sequence shards (pick a dividing --sequence_length)"
             )
-        # Zigzag layout (causal load balance — tpukit/ring_attention.py):
-        # permute the sequence so each shard holds one early + one late
-        # chunk; every per-token computation (embeddings, MLPs, CE sums) is
-        # permutation-invariant, so only the ring schedule needs to know.
-        # Falls back to the contiguous ring when 2*P doesn't divide S.
-        # The ulysses schedule keeps the contiguous layout (its local
-        # attention sees the full gathered sequence).
-        use_zigzag = (
-            self.attention == "ring"
-            and seq_len % (2 * self.seq_size) == 0
-            and self.seq_size > 1
-        )
-        if use_zigzag:
+        use_zigzag = self._use_zigzag(seq_len)
+        if use_zigzag and not self.host_permute:
             from tpukit.ring_attention import zigzag_order
 
             order = zigzag_order(seq_len, self.seq_size)

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""GPipe vs 1F1B schedule step time (VERDICT r4 #5).
+
+Times one full train step (fwd + bwd + AdamW) for both pipeline schedules
+at 2 and 4 stages. Multi-chip TPU hardware is unavailable in this
+environment (one real chip), so the comparison runs on the virtual CPU
+mesh — schedule-overhead-relative numbers: the 1F1B premium measured here
+is an UPPER bound on TPU, where the remat recompute rides the MXU and the
+per-tick ppermutes ride ICI instead of host memcpy. docs/DESIGN.md records
+the table.
+
+    TPUKIT_CPU_DEVICES=8 python tools/bench_pipeline.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+os.environ.setdefault("TPUKIT_CPU_DEVICES", "8")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench(schedule: str, stages: int, micro_mult: int = 4, steps: int = 4,
+          windows: int = 3, dim: int = 128, layers: int = 8, seq: int = 128):
+    from tpukit.mesh import create_mesh
+    from tpukit.model import GPTConfig
+    from tpukit.pipeline import Pipeline, Pipeline1F1B
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    cls = {"gpipe": Pipeline, "1f1b": Pipeline1F1B}[schedule]
+    strat = cls(create_mesh({"stage": stages}), num_microbatches=micro_mult * stages)
+    cfg = GPTConfig(
+        dim=dim, head_dim=dim // 4, heads=4, num_layers=layers,
+        vocab_size=8192, max_position_embeddings=seq,
+        compute_dtype=jnp.bfloat16, scan_layers=True,
+    )
+    opt = make_optimizer(1e-4)
+    state = create_train_state(jax.random.PRNGKey(0), cfg, opt, strat)
+    step, _, sh = make_step_fns(cfg, opt, strat, jax.eval_shape(lambda: state))
+    state = jax.device_put(state, sh)
+
+    batch_rows = micro_mult * stages  # one row per micro-batch
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(batch_rows, seq)).astype(np.int32)
+    batch = {
+        "input_ids": ids,
+        "position_ids": np.ascontiguousarray(
+            np.broadcast_to(np.arange(seq, dtype=np.int32), ids.shape)
+        ),
+        "mask": np.zeros_like(ids, dtype=bool),
+    }
+    targets = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    for _ in range(2):
+        state, loss = step(state, batch, targets)
+    float(loss)
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = step(state, batch, targets)
+        float(loss)
+        best = min(best, time.perf_counter() - t0)
+    return best / steps * 1e3  # ms/step
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=4)
+    args = p.parse_args()
+    for stages in (2, 4):
+        row = {"stages": stages, "microbatches": 4 * stages}
+        for schedule in ("gpipe", "1f1b"):
+            row[f"{schedule}_ms"] = round(bench(schedule, stages, steps=args.steps), 1)
+        row["ratio_1f1b_over_gpipe"] = round(row["1f1b_ms"] / row["gpipe_ms"], 3)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Recipe 8 (tpukit extension): mixture-of-experts training with expert
+parallelism.
+
+The reference cookbook has no MoE and no expert parallelism (SURVEY §2.4
+marks the EP row "not required"); this recipe closes that row anyway, the
+TPU way. `--num_experts N` replaces every layer's FFN with a Switch-style
+top-1 routed expert bank (fixed-capacity dispatch — static shapes — and
+the Switch load-balance aux loss; see tpukit/model/gpt.py _apply_moe_ffn).
+The ExpertParallel strategy shards the expert axis over an `expert` mesh
+axis while batch rows shard over every device: GSPMD turns the
+dispatch/combine einsums into the token all_to_alls GPU MoE frameworks
+hand-write with NCCL (tpukit/shardings.py ExpertParallel).
+
+The device grid puts `expert` innermost (its all_to_alls ride the fastest
+ICI links) with remaining devices data-parallel, e.g. 8 devices and 8
+experts -> (data=1, expert=8); 8 devices and 4 experts -> (data=2,
+expert=4).
+
+Run: `python main-moe.py --num_experts 8 --batch_size 64 ...`
+(batch_size is per data shard, as in the per-rank reference loader).
+"""
+
+import jax
+
+from tpukit.flags import parse_flags
+from tpukit.mesh import create_mesh
+from tpukit.shardings import ExpertParallel
+from tpukit.train import fit
+
+
+def pick_grid(n_devices: int, num_experts: int) -> dict:
+    """Largest expert-parallel degree that divides both the device count
+    and the expert count; remaining devices become data-parallel."""
+    expert = 1
+    for e in range(1, n_devices + 1):
+        if n_devices % e == 0 and num_experts % e == 0:
+            expert = e
+    return {"data": n_devices // expert, "expert": expert}
+
+
+def main(argv=None):
+    flags = parse_flags(argv, num_experts=True)
+    grid = pick_grid(len(jax.devices()), flags.num_experts)
+    return fit(flags, ExpertParallel(create_mesh(grid)))
+
+
+if __name__ == "__main__":
+    main()

@@ -115,3 +115,9 @@ def test_recipe_pipe_1f1b(tmp_path):
         "main-pipe.py", tmp_path,
         extra=["--num_layers", "8", "--microbatches", "8", "--schedule", "1f1b"],
     )
+
+
+def test_recipe_moe(tmp_path):
+    # grid picker -> (data=1, expert=8) on 8 devices with the default 8
+    # experts; MoE routing + aux loss + EP shardings through fit()
+    _run_recipe("main-moe.py", tmp_path)

@@ -74,6 +74,9 @@ class TrainFlags:
     # embeddings/head) or "1f1b" (explicit per-stage vjps — activation
     # memory bounded by the stage count instead of the micro count).
     pipeline_schedule: str = "gpipe"
+    # main-moe.py only: number of routed experts replacing each layer's FFN
+    # (Switch-style top-1 routing; 0 = the dense reference model).
+    num_experts: int = 0
 
 
 # The canonical 12 flags of every reference recipe (main-single.py:156-167).
@@ -95,6 +98,7 @@ def build_parser(
     cpu_offload: bool = False,
     cp_attention: bool = False,
     pipeline_schedule: bool = False,
+    num_experts: bool = False,
 ) -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser()
     defaults = TrainFlags()
@@ -113,6 +117,8 @@ def build_parser(
             "--schedule", dest="pipeline_schedule",
             choices=("gpipe", "1f1b"), default="gpipe",
         )
+    if num_experts:
+        parser.add_argument("--num_experts", type=int, default=8)
     parser.add_argument("--seed", type=int, default=defaults.seed)
     parser.add_argument("--dropout", type=float, default=defaults.dropout)
     parser.add_argument("--checkpoint_every", type=int, default=defaults.checkpoint_every)
@@ -136,14 +142,17 @@ def parse_flags(
     cpu_offload: bool = False,
     cp_attention: bool = False,
     pipeline_schedule: bool = False,
+    num_experts: bool = False,
 ) -> TrainFlags:
     ns = build_parser(
         cpu_offload=cpu_offload,
         cp_attention=cp_attention,
         pipeline_schedule=pipeline_schedule,
+        num_experts=num_experts,
     ).parse_args(argv)
     kw = vars(ns)
     kw.setdefault("cpu_offload", False)
     kw.setdefault("cp_attention", "ring")
     kw.setdefault("pipeline_schedule", "gpipe")
+    kw.setdefault("num_experts", 0)
     return TrainFlags(**kw)

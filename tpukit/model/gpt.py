@@ -107,6 +107,20 @@ class GPTConfig:
     # kernels are column-sharded and concatenating along the sharded axis
     # would re-lay-out the weights every step.
     fuse_qkv: bool = True
+    # Mixture-of-experts FFN (beyond-reference: the cookbook has no MoE,
+    # SURVEY §2.4 marks EP "not required"). num_experts > 0 replaces every
+    # layer's FFN with a Switch-style top-1 routed expert bank: a linear
+    # router picks one expert per token, tokens dispatch into fixed-size
+    # per-expert buffers (capacity = ceil(tokens/E * capacity_factor) —
+    # STATIC shapes, the TPU requirement), overflow tokens fall through the
+    # residual with zero FFN output, and a load-balance aux loss
+    # (Switch Transformer eq. 4: E * sum(frac_tokens_e * mean_prob_e))
+    # keeps routing uniform. Each expert applies the reference FFN
+    # (up -> relu -> down -> relu, the double-relu quirk preserved). See
+    # tpukit/shardings.py ExpertParallel for the expert-sharded execution.
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def inner_dim(self) -> int:
@@ -145,9 +159,32 @@ def _layer_norm_params(dim: int, dtype) -> dict:
 
 
 def _init_decoder_layer(rng, cfg: GPTConfig) -> dict:
-    """One DecoderLayer (models/gpt.py:108-135): attn + ffn + two norms."""
-    rngs = jax.random.split(rng, 6)
+    """One DecoderLayer (models/gpt.py:108-135): attn + ffn + two norms.
+    With cfg.num_experts > 0 the ffn is a router + stacked expert bank
+    (leading axis num_experts on every expert leaf)."""
+    rngs = jax.random.split(rng, 7)
     dtype = cfg.param_dtype
+    if cfg.num_experts > 0:
+        up = partial(
+            _linear_params, fan_in=cfg.dim, fan_out=cfg.dim * cfg.ffn_mult,
+            bias=True, dtype=dtype,
+        )
+        down = partial(
+            _linear_params, fan_in=cfg.dim * cfg.ffn_mult, fan_out=cfg.dim,
+            bias=True, dtype=dtype,
+        )
+        ffn = {
+            "router": _linear_params(rngs[6], cfg.dim, cfg.num_experts, bias=False, dtype=dtype),
+            "experts": {
+                "up": jax.vmap(up)(jax.random.split(rngs[4], cfg.num_experts)),
+                "down": jax.vmap(down)(jax.random.split(rngs[5], cfg.num_experts)),
+            },
+        }
+    else:
+        ffn = {
+            "up": _linear_params(rngs[4], cfg.dim, cfg.dim * cfg.ffn_mult, bias=True, dtype=dtype),
+            "down": _linear_params(rngs[5], cfg.dim * cfg.ffn_mult, cfg.dim, bias=True, dtype=dtype),
+        }
     return {
         "norm1": _layer_norm_params(cfg.dim, dtype),
         "attn": {
@@ -157,10 +194,7 @@ def _init_decoder_layer(rng, cfg: GPTConfig) -> dict:
             "out": _linear_params(rngs[3], cfg.inner_dim, cfg.dim, bias=True, dtype=dtype),
         },
         "norm2": _layer_norm_params(cfg.dim, dtype),
-        "ffn": {
-            "up": _linear_params(rngs[4], cfg.dim, cfg.dim * cfg.ffn_mult, bias=True, dtype=dtype),
-            "down": _linear_params(rngs[5], cfg.dim * cfg.ffn_mult, cfg.dim, bias=True, dtype=dtype),
-        },
+        "ffn": ffn,
     }
 
 
@@ -213,6 +247,74 @@ def _apply_feed_forward(layer, cfg: GPTConfig, x, rng, deterministic):
     return dropout(h, cfg.dropout, rng, deterministic)
 
 
+def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic):
+    """Switch-style top-1 mixture-of-experts FFN. Returns (out, aux).
+
+    TPU-first design: STATIC shapes throughout — tokens dispatch into a
+    fixed `[E, B, capacity, dim]` buffer via one-hot einsums, each expert
+    runs the reference FFN (up -> relu -> down -> relu, the double-relu
+    quirk, models/gpt.py:33-41) as one batched matmul pair on the MXU, and
+    a transposed one-hot einsum combines the results scaled by the router
+    gate. Capacity is PER ROW (position within an expert = causal cumsum
+    of its assignment mask along the sequence), so rows never compete for
+    expert slots: eval losses are batch-composition-independent and the
+    batched decode stays token-for-token equal to the serial one. Tokens
+    beyond an expert's row capacity get zero FFN output (they ride the
+    residual stream). Router math is f32 (softmax stability under bf16
+    compute). `aux` is the Switch load-balance loss
+    E * sum(frac_tokens_e * mean_router_prob_e), averaged over rows — 1.0
+    at perfect balance. The KV-cached decode routes each chunk with its
+    own capacity window, so a capacity-dropped token can differ from the
+    full-reforward path there — use_cache=False is exact.
+
+    Under ExpertParallel (tpukit/shardings.py) the expert axis of the
+    buffers/kernels is sharded over the `expert` mesh axis and GSPMD turns
+    the dispatch/combine einsums into all_to_all-style collectives — the
+    NCCL all_to_all of GPU MoE frameworks, emitted from sharding specs.
+    """
+    batch, seq_len, dim = x.shape
+    experts = layer["ffn"]["experts"]
+    n_exp = cfg.num_experts
+    capacity = max(1, int(-(-seq_len * cfg.expert_capacity_factor // n_exp)))
+
+    xc = x.astype(cfg.compute_dtype)
+    logits = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32),
+        layer["ffn"]["router"]["kernel"].astype(jnp.float32),
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [B, S, E] f32
+    gate = jnp.max(probs, axis=-1)  # top-1 router prob
+    choice = jnp.argmax(probs, axis=-1)
+    assign = jax.nn.one_hot(choice, n_exp, dtype=jnp.float32)  # [B, S, E]
+
+    # position of each token in its expert's per-row buffer (cumsum along
+    # the sequence is causal: later tokens never evict earlier ones);
+    # >= capacity drops
+    pos = jnp.cumsum(assign, axis=1) * assign - 1.0
+    kept = assign * (pos < capacity)
+    slot = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    dispatch = (
+        kept[..., None] * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+    ).astype(cfg.compute_dtype)  # [B, S, E, C]
+
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, xc)
+    h = jnp.einsum(
+        "ebcd,edf->ebcf", expert_in, experts["up"]["kernel"].astype(cfg.compute_dtype)
+    ) + experts["up"]["bias"].astype(cfg.compute_dtype)[:, None, None, :]
+    h = jax.nn.relu(h)
+    h = jnp.einsum(
+        "ebcf,efd->ebcd", h, experts["down"]["kernel"].astype(cfg.compute_dtype)
+    ) + experts["down"]["bias"].astype(cfg.compute_dtype)[:, None, None, :]
+    h = jax.nn.relu(h)
+    combined = jnp.einsum("ebcd,bsec->bsd", h, dispatch)
+    out = combined * gate.astype(cfg.compute_dtype)[..., None]
+
+    frac_tokens = jnp.mean(assign, axis=1)  # [B, E]
+    mean_prob = jnp.mean(probs, axis=1)  # [B, E]
+    aux = n_exp * jnp.mean(jnp.sum(frac_tokens * mean_prob, axis=-1))
+    return dropout(out, cfg.dropout, rng, deterministic), aux
+
+
 def _apply_attention(layer, cfg: GPTConfig, x, pad_mask, rng, deterministic):
     """SelfAttention (models/gpt.py:68-105).
 
@@ -250,7 +352,10 @@ def _apply_attention(layer, cfg: GPTConfig, x, pad_mask, rng, deterministic):
 
 
 def apply_decoder_layer(layer: Params, cfg: GPTConfig, x, pad_mask, rng=None, deterministic=True):
-    """Pre-LN block (models/gpt.py:124-135)."""
+    """Pre-LN block (models/gpt.py:124-135). With cfg.num_experts > 0 the
+    FFN is the routed expert bank and the return is `(x, aux)` — the
+    branch is on a STATIC config field, so the dense path's signature and
+    compiled graph are untouched."""
     if rng is None:
         attn_rng = ffn_rng = None
     else:
@@ -258,13 +363,16 @@ def apply_decoder_layer(layer: Params, cfg: GPTConfig, x, pad_mask, rng=None, de
     h = layer_norm(x, layer["norm1"]).astype(cfg.compute_dtype)
     x = x + _apply_attention(layer, cfg, h, pad_mask, attn_rng, deterministic)
     h = layer_norm(x, layer["norm2"]).astype(cfg.compute_dtype)
+    if cfg.num_experts > 0:
+        ffn_out, aux = _apply_moe_ffn(layer, cfg, h, ffn_rng, deterministic)
+        return x + ffn_out, aux
     x = x + _apply_feed_forward(layer, cfg, h, ffn_rng, deterministic)
     return x
 
 
 def apply_decoder_layers(
     stacked_layers: Params, cfg: GPTConfig, x, pad_mask, rng=None, deterministic=True,
-    active=None,
+    active=None, aux_out: list | None = None,
 ) -> jax.Array:
     """Sequential layer stack (models/gpt.py:161-167) over the stacked layer
     parameters. Works for any leading stack size, so pipeline stages call it
@@ -275,12 +383,17 @@ def apply_decoder_layers(
     and its parameters receive zero gradient (the `where` selects the
     residual stream, so the layer branch is dead in the backward pass).
 
+    `aux_out` (MoE only): a list the summed per-layer load-balance aux loss
+    is appended to — a trace-time side channel, appended OUTSIDE any scan
+    body so no tracer leaks. Ignored for dense configs.
+
     Execution is controlled by cfg.scan_layers (unrolled blocks vs one
     lax.scan body) and cfg.remat_layers (checkpoint each layer); see the
     GPTConfig field docs for the measured trade-offs. Both paths are
     numerically identical (tests/test_model.py::test_scan_matches_unrolled).
     """
     num = jax.tree_util.tree_leaves(stacked_layers)[0].shape[0]
+    moe = cfg.num_experts > 0
 
     layer_fn = apply_decoder_layer
     if cfg.remat_layers:
@@ -296,12 +409,20 @@ def apply_decoder_layers(
         use_rng = True
 
     if not cfg.scan_layers:
+        aux_total = jnp.float32(0)
         for i in range(num):
             layer = jax.tree_util.tree_map(lambda t: t[i], stacked_layers)
             y = layer_fn(
                 layer, cfg, x, pad_mask, rngs[i] if use_rng else None, deterministic
             )
+            if moe:
+                y, aux = y
+                aux_total = aux_total + (
+                    aux if active is None else jnp.where(active[i], aux, 0.0)
+                )
             x = y if active is None else jnp.where(active[i], y, x)
+        if moe and aux_out is not None:
+            aux_out.append(aux_total)
         return x
 
     if active is None:
@@ -312,14 +433,22 @@ def apply_decoder_layers(
 
     def body(carry, scanned):
         layer, layer_rng, act = scanned
+        x, aux_total = carry
         out = layer_fn(
-            layer, cfg, carry, pad_mask, layer_rng if use_rng else None, deterministic
+            layer, cfg, x, pad_mask, layer_rng if use_rng else None, deterministic
         )
+        if moe:
+            out, aux = out
+            aux_total = aux_total + jnp.where(act, aux.astype(jnp.float32), 0.0)
         if gate:
-            out = jnp.where(act, out, carry)
-        return out, None
+            out = jnp.where(act, out, x)
+        return (out, aux_total), None
 
-    x, _ = jax.lax.scan(body, x, (stacked_layers, rngs, active))
+    (x, aux_total), _ = jax.lax.scan(
+        body, (x, jnp.float32(0)), (stacked_layers, rngs, active)
+    )
+    if moe and aux_out is not None:
+        aux_out.append(aux_total)
     return x
 
 
@@ -382,7 +511,11 @@ def forward_cached(params: Params, cfg: GPTConfig, input_ids, position_ids, cach
         new_v.append(v_c)
         x = x + attn
         h = layer_norm(x, layer["norm2"]).astype(cfg.compute_dtype)
-        x = x + _apply_feed_forward(layer, cfg, h, None, True)
+        if cfg.num_experts > 0:
+            ffn_out, _ = _apply_moe_ffn(layer, cfg, h, None, True)
+            x = x + ffn_out
+        else:
+            x = x + _apply_feed_forward(layer, cfg, h, None, True)
     cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
     return apply_head(params, cfg, x), cache
 
@@ -395,6 +528,7 @@ def forward_hidden(
     mask: jax.Array | None = None,
     rng: jax.Array | None = None,
     deterministic: bool = True,
+    aux_out: list | None = None,
 ) -> jax.Array:
     """Everything up to (and including) the final LayerNorm — the hidden
     states the LM head consumes. Split out so the fused head+CE kernel
@@ -402,7 +536,9 @@ def forward_hidden(
     logits ever materializing; `forward` == `apply_head`-minus-norm of
     this."""
     x = apply_embeddings(params, cfg, input_ids, position_ids)
-    x = apply_decoder_layers(params["layers"], cfg, x, mask, rng, deterministic)
+    x = apply_decoder_layers(
+        params["layers"], cfg, x, mask, rng, deterministic, aux_out=aux_out
+    )
     return layer_norm(x, params["norm_out"]).astype(cfg.compute_dtype)
 
 
@@ -430,6 +566,7 @@ def forward(
     mask: jax.Array | None = None,
     rng: jax.Array | None = None,
     deterministic: bool = True,
+    aux_out: list | None = None,
 ) -> jax.Array:
     """Full model: logits `[B, S, vocab]` in the compute dtype.
 
@@ -439,7 +576,9 @@ def forward(
     `prepare_batch`, reference utils.py:36).
     """
     x = apply_embeddings(params, cfg, input_ids, position_ids)
-    x = apply_decoder_layers(params["layers"], cfg, x, mask, rng, deterministic)
+    x = apply_decoder_layers(
+        params["layers"], cfg, x, mask, rng, deterministic, aux_out=aux_out
+    )
     return apply_head(params, cfg, x)
 
 

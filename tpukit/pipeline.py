@@ -150,6 +150,12 @@ class Pipeline(Strategy):
     def validate_config(self, cfg: gpt.GPTConfig) -> None:
         if cfg.num_layers < 1:
             raise ValueError(f"num_layers must be >= 1, got {cfg.num_layers}")
+        if cfg.num_experts > 0:
+            raise ValueError(
+                "the pipeline schedules do not support MoE configs (the "
+                "micro-batched loss paths have no aux-loss channel) — use "
+                "ExpertParallel (main-moe.py), optionally with a data axis"
+            )
 
     def _vocab_spec(self, names: tuple, shape: tuple) -> P | None:
         """Single source of truth for vocab-over-stage placement. Both

@@ -192,8 +192,11 @@ def generate(
     if use_cache is None:
         # Measured on v5e: the cached path wins on long buffers (O(S) vs
         # O(S^2) per token) but its per-step cache updates cost more than
-        # the naive re-forward saves on short ones.
-        use_cache = buf.shape[1] >= 512
+        # the naive re-forward saves on short ones. MoE models default to
+        # the exact full-reforward path: the cached decode routes each
+        # chunk with its own expert-capacity window, which can diverge
+        # from full-sequence routing (gpt._apply_moe_ffn docstring).
+        use_cache = buf.shape[1] >= 512 and cfg.num_experts == 0
     loop = _decode_loop_cached if use_cache else _decode_loop
     buf, length = loop(
         params, cfg, _replicate_like(params, buf), prompt_len, max_new_tokens, int(eos)

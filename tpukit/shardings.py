@@ -124,7 +124,7 @@ class Strategy:
 
     def loss_fn(
         self, params, cfg: gpt.GPTConfig, batch, targets,
-        with_accuracy: bool = False, rng=None,
+        with_accuracy: bool = False, rng=None, aux_out: list | None = None,
     ):
         """Default forward + masked CE (+ masked accuracy for eval).
 
@@ -138,6 +138,10 @@ class Strategy:
         (threefry is partitionable), so dropout is consistent across DP/FSDP
         shards — the twin of torch dropout running under DDP.
 
+        `aux_out` (MoE configs): list receiving the summed load-balance aux
+        loss; value_and_grad passes it so training optimizes
+        CE + moe_aux_weight * aux while eval metrics stay pure CE.
+
         The head + cross-entropy run through the fused Pallas kernel
         (ops/fused_head_ce.py) unless the strategy opts out: no logits
         buffer in HBM, which is both the long-context perf win and what
@@ -149,6 +153,7 @@ class Strategy:
             h = gpt.forward_hidden(
                 params, cfg, batch["input_ids"], batch["position_ids"],
                 batch["mask"], rng=rng, deterministic=rng is None,
+                aux_out=aux_out,
             )
             loss_sum, count, correct = fused_head_ce(
                 h.reshape(-1, h.shape[-1]),
@@ -161,7 +166,7 @@ class Strategy:
             return loss_sum / denom, correct / denom * 100.0
         logits = gpt.forward(
             params, cfg, batch["input_ids"], batch["position_ids"], batch["mask"],
-            rng=rng, deterministic=rng is None,
+            rng=rng, deterministic=rng is None, aux_out=aux_out,
         )
         loss = cross_entropy_loss(logits, targets)
         accuracy = masked_accuracy(logits, targets) if with_accuracy else jnp.float32(0)
@@ -171,13 +176,32 @@ class Strategy:
         """Loss and parameter gradients for one global batch — the training
         half of the strategy contract (make_step_fns calls this). Default:
         autodiff over `loss_fn`. Schedules that must build their gradient
-        explicitly (Pipeline1F1B's per-stage vjps) override it."""
+        explicitly (Pipeline1F1B's per-stage vjps) override it.
 
-        def loss_of(p):
-            loss, _ = self.loss_fn(p, cfg, batch, targets, rng=rng)
-            return loss
+        MoE configs train on CE + moe_aux_weight * load-balance aux (the
+        Switch objective); the RETURNED loss is the pure CE, so the train
+        bar and eval report the same quantity."""
 
-        return jax.value_and_grad(loss_of)(params)
+        if cfg.num_experts == 0:
+
+            def loss_of(p):
+                loss, _ = self.loss_fn(p, cfg, batch, targets, rng=rng)
+                return loss
+
+            return jax.value_and_grad(loss_of)(params)
+
+        def loss_of_moe(p):
+            aux_list: list = []
+            loss, _ = self.loss_fn(
+                p, cfg, batch, targets, rng=rng, aux_out=aux_list
+            )
+            total = loss
+            for aux in aux_list:
+                total = total + cfg.moe_aux_weight * aux
+            return total, loss
+
+        (_, loss), grads = jax.value_and_grad(loss_of_moe, has_aux=True)(params)
+        return loss, grads
 
     def describe(self) -> str:
         return f"{self.name} over mesh {dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}"
@@ -326,6 +350,12 @@ class ContextParallel(Strategy):
         return P(data, "seq")
 
     def validate_config(self, cfg: gpt.GPTConfig) -> None:
+        if cfg.num_experts > 0:
+            raise ValueError(
+                "ContextParallel does not support MoE configs (the routed "
+                "dispatch is token-global, the CP loss is seq-sharded) — "
+                "use ExpertParallel (main-moe.py) for num_experts > 0"
+            )
         # The model consumes sequence_length - 1 tokens after the LM shift
         # (prepare_batch, tpukit/batching.py).
         seq = cfg.max_position_embeddings - 1
@@ -534,6 +564,79 @@ class TensorParallel(Strategy):
         if "token" in names:
             return shard(0)  # vocab rows
         del path
+        return P()
+
+    def state_sharding(self, state_shapes):
+        def spec(path, leaf):
+            names = tuple(
+                k.key for k in path if isinstance(k, jax.tree_util.DictKey)
+            )
+            return NamedSharding(self.mesh, self._spec_for(names, leaf.shape))
+
+        return jax.tree_util.tree_map_with_path(spec, state_shapes)
+
+    def validate_config(self, cfg: gpt.GPTConfig) -> None:
+        if cfg.num_experts > 0:
+            raise ValueError(
+                "TensorParallel does not support MoE configs (the Megatron "
+                "column/row rules assume dense FFN kernels) — use "
+                "ExpertParallel (main-moe.py) for num_experts > 0"
+            )
+
+
+class ExpertParallel(Strategy):
+    """Expert parallelism for MoE configs (beyond-reference: the cookbook
+    has neither MoE nor EP — SURVEY §2.4 marks the row "not required").
+
+    Classic layout on a `(data, expert)` mesh: batch rows shard over BOTH
+    axes (the attention/router trunk is plain data parallelism over every
+    device), while each expert-bank leaf (`ffn/experts/*`, leading axes
+    `[layers, num_experts, ...]`) shards its EXPERT axis over `expert`. The
+    dispatch einsum `[T, E, C] x [T, D] -> [E, C, D]` then contracts a
+    token-sharded operand into an expert-sharded result, so GSPMD emits the
+    token all_to_all GPU MoE frameworks hand-write with NCCL, and the
+    combine einsum emits the return trip. The router, attention, norms, and
+    embeddings stay replicated; their gradient psum and the expert-grad
+    reduce fall out of the sharding specs. Optimizer state mirrors the
+    parameter placement, so each device holds only its experts' Adam
+    moments — the memory point of EP.
+    """
+
+    name = "ep"
+
+    def __init__(self, mesh: Mesh | None = None):
+        self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"expert": -1})
+        if "expert" not in self.mesh.axis_names:
+            raise ValueError("ExpertParallel needs an 'expert' mesh axis")
+        self.expert_size = self.mesh.shape["expert"]
+        self.data_size = self.mesh.shape.get("data", 1)
+
+    def batch_spec(self) -> P:
+        axes = tuple(a for a in ("data", "expert") if a in self.mesh.axis_names)
+        return P(axes)
+
+    @property
+    def batch_divisor(self) -> int:
+        return self.data_size * self.expert_size
+
+    def validate_config(self, cfg: gpt.GPTConfig) -> None:
+        if cfg.num_experts <= 0:
+            raise ValueError(
+                "ExpertParallel requires an MoE config: pass --num_experts N "
+                "(N > 0); dense models belong on the other strategies"
+            )
+        if cfg.num_experts % self.expert_size:
+            raise ValueError(
+                f"--num_experts {cfg.num_experts} must divide over the "
+                f"{self.expert_size}-way expert mesh axis"
+            )
+
+    def _spec_for(self, names: tuple[str, ...], shape: tuple[int, ...]) -> P:
+        if "experts" in names:
+            # stacked layout [num_layers, num_experts, ...]: expert axis 1
+            spec = [None] * len(shape)
+            spec[1] = "expert"
+            return P(*spec)
         return P()
 
     def state_sharding(self, state_shapes):

@@ -55,7 +55,7 @@ from tpukit.loader import DataLoader
 from tpukit.mesh import initialize_runtime, is_process_zero
 from tpukit.model import gpt
 from tpukit.profiling import MFUMeter, StepLogger, trace
-from tpukit.sampling import generate, generate_batch
+from tpukit.sampling import generate_batch
 from tpukit.shardings import Strategy
 
 PRINT_FREQ = 8  # twin of main-single.py:19
@@ -188,8 +188,6 @@ def _valid_count(targets):
     return jnp.sum(targets != IGNORE_INDEX)
 
 
-
-
 @functools.lru_cache(maxsize=None)
 def _replicator(mesh):
     """One jitted all-gather-to-replicated program per mesh — rebuilding the
@@ -303,6 +301,7 @@ def fit(
         compute_dtype=compute_dtype,
         remat_layers=flags.remat,
         scan_layers=flags.scan_layers,
+        num_experts=flags.num_experts,
     )
     optimizer = make_optimizer(flags.learning_rate)
     strategy.validate_config(cfg)  # fail fast with a clear shape/mesh error

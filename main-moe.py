@@ -21,6 +21,8 @@ Run: `python main-moe.py --num_experts 8 --batch_size 64 ...`
 (batch_size is per data shard, as in the per-rank reference loader).
 """
 
+import math
+
 import jax
 
 from tpukit.flags import parse_flags
@@ -31,11 +33,8 @@ from tpukit.train import fit
 
 def pick_grid(n_devices: int, num_experts: int) -> dict:
     """Largest expert-parallel degree that divides both the device count
-    and the expert count; remaining devices become data-parallel."""
-    expert = 1
-    for e in range(1, n_devices + 1):
-        if n_devices % e == 0 and num_experts % e == 0:
-            expert = e
+    and the expert count — their gcd; remaining devices are data-parallel."""
+    expert = math.gcd(n_devices, num_experts)
     return {"data": n_devices // expert, "expert": expert}
 
 

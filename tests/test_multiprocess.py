@@ -238,6 +238,23 @@ def test_cp_two_process_world_matches_single(tmp_path, cp_args):
 
 
 @pytest.mark.slow
+def test_moe_two_process_world_matches_single(tmp_path):
+    """Expert parallelism across 2 processes: the 8-way expert mesh axis
+    spans the host boundary, so the MoE dispatch/combine all_to_alls and
+    the expert-grad reductions cross processes."""
+    mp_dir = tmp_path / "mp"
+    mp_dir.mkdir()
+    results = _launch_world("main-moe.py", mp_dir, extra=["--num_experts", "8"])
+    assert abs(results[0]["eval_loss"] - results[1]["eval_loss"]) < 1e-5
+    assert np.isfinite(results[0]["eval_loss"])
+
+    single_dir = tmp_path / "single"
+    single_dir.mkdir()
+    ref = _single_world_loss("main-moe.py", single_dir, extra=["--num_experts", "8"])
+    assert abs(results[0]["eval_loss"] - ref) < 5e-2
+
+
+@pytest.mark.slow
 def test_fsdp_kill_midrun_resume(tmp_path):
     """VERDICT r4 #3: the failure-recovery path, for real. Train a
     2-process FSDP world with periodic sharded checkpointing, SIGKILL both

@@ -257,11 +257,14 @@ def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic):
     a transposed one-hot einsum combines the results scaled by the router
     gate. Capacity is PER ROW (position within an expert = causal cumsum
     of its assignment mask along the sequence), so rows never compete for
-    expert slots: eval losses are batch-composition-independent and the
-    batched decode stays token-for-token equal to the serial one. Tokens
-    beyond an expert's row capacity get zero FFN output (they ride the
-    residual stream). Router math is f32 (softmax stability under bf16
-    compute). `aux` is the Switch load-balance loss
+    expert slots, and it derives from the STATIC max_position_embeddings —
+    not the call's sequence width — so a row's dispatch is identical
+    whatever buffer padding surrounds it: eval losses are
+    batch-composition-independent and the batched decode stays
+    token-for-token equal to the serial one even when their buffer widths
+    differ. Tokens beyond an expert's row capacity get zero FFN output
+    (they ride the residual stream). Router math is f32 (softmax stability
+    under bf16 compute). `aux` is the Switch load-balance loss
     E * sum(frac_tokens_e * mean_router_prob_e), averaged over rows — 1.0
     at perfect balance. The KV-cached decode routes each chunk with its
     own capacity window, so a capacity-dropped token can differ from the
@@ -275,7 +278,10 @@ def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic):
     batch, seq_len, dim = x.shape
     experts = layer["ffn"]["experts"]
     n_exp = cfg.num_experts
-    capacity = max(1, int(-(-seq_len * cfg.expert_capacity_factor // n_exp)))
+    capacity = max(
+        1,
+        int(-(-cfg.max_position_embeddings * cfg.expert_capacity_factor // n_exp)),
+    )
 
     xc = x.astype(cfg.compute_dtype)
     logits = jnp.einsum(

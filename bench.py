@@ -68,15 +68,18 @@ def main():
 
     # Best of four timing windows: the shared/tunneled chip shows double-
     # digit run-to-run variance from external load; the fastest window is
-    # the honest steady-state throughput of THIS program.
+    # the honest steady-state throughput of THIS program. All window times
+    # are kept so the JSON can report the spread (VERDICT r4: a headline
+    # that sits on the target bar needs its noise band stated).
     steps = 12
-    best = float("inf")
+    windows = []
     for _ in range(4):
         t0 = time.perf_counter()
         for _ in range(steps):
             state, loss = train_step(state, model_batch, targets)
         final_loss = float(loss)
-        best = min(best, time.perf_counter() - t0)
+        windows.append(time.perf_counter() - t0)
+    best = min(windows)
 
     tokens = steps * batch * (seq - 1)
     tps = tokens / best
@@ -179,6 +182,12 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.35, 4) if mfu is not None else None,
         "mfu": round(mfu, 4) if mfu is not None else None,
+        # spread across the four timing windows on this shared chip: the
+        # slowest window's MFU (lower bound seen THIS run) vs the reported
+        # best — the honest noise band around the headline number
+        "mfu_window_min": (
+            round(mfu * best / max(windows), 4) if mfu is not None else None
+        ),
         "tokens_per_sec_total": round(tps, 1),
         "long_context_s2048_tokens_per_sec_per_chip": round(long_tps, 1) if long_tps else None,
         "long_context_error": long_err,

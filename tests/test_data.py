@@ -83,6 +83,35 @@ def test_distributed_indices_epoch_reshuffle():
     assert not np.array_equal(a, b)
 
 
+def test_dataloader_global_real_row_counts():
+    """The precomputed global schedule (throughput meter, VERDICT r4 #6)
+    must equal the sum of every rank's per-batch real_rows, for ragged
+    dataset sizes, any epoch shuffle, and both pad modes."""
+    n = 61  # odd over 2 ranks: ranks end with differing real counts
+    ds = ArrayDataset(
+        np.arange(4 * n).reshape(n, 4).astype(np.int32),
+        np.ones((n, 4), dtype=np.int32),
+    )
+    for pad_mode in ("wrap", "empty"):
+        loaders = [
+            DataLoader(
+                ds, batch_size=8, shuffle=True, seed=3, num_replicas=2,
+                rank=r, pad_to_batch=True, pad_mode=pad_mode,
+            )
+            for r in range(2)
+        ]
+        for epoch in (0, 2):
+            for ld in loaders:
+                ld.set_epoch(epoch)
+            expected = None
+            for ld in loaders:
+                per = np.array([b["real_rows"] for b in ld])
+                expected = per if expected is None else expected + per
+            got = loaders[0].global_real_row_counts()
+            np.testing.assert_array_equal(got, expected)
+            assert int(got.sum()) == n  # every original row counted once
+
+
 def test_dataloader_batching():
     ds = ArrayDataset(
         np.arange(40).reshape(10, 4).astype(np.int32),

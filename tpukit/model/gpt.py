@@ -278,10 +278,15 @@ def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic):
     batch, seq_len, dim = x.shape
     experts = layer["ffn"]["experts"]
     n_exp = cfg.num_experts
+    # Derived from the STATIC position-table size (width invariance), then
+    # clamped to the call width: a row position can never reach seq_len, so
+    # the clamp is output-identical while keeping short decode buffers from
+    # paying full-table-sized dispatch/combine einsums.
     capacity = max(
         1,
         int(-(-cfg.max_position_embeddings * cfg.expert_capacity_factor // n_exp)),
     )
+    capacity = min(capacity, seq_len)
 
     xc = x.astype(cfg.compute_dtype)
     logits = jnp.einsum(

@@ -33,9 +33,21 @@ import numpy as np
 from tpukit.model import gpt
 
 
-@partial(jax.jit, static_argnames=("cfg", "prompt_len", "max_new_tokens", "eos_id"))
-def _decode_loop(params, cfg: gpt.GPTConfig, buf, prompt_len: int, max_new_tokens: int, eos_id: int):
-    """Returns (buf, final_length). buf: [1, prompt_len + max_new_tokens]."""
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "prompt_len", "max_new_tokens", "eos_id", "temperature", "top_k"),
+)
+def _decode_loop(
+    params, cfg: gpt.GPTConfig, buf, prompt_len: int, max_new_tokens: int,
+    eos_id: int, temperature: float = 0.0, top_k: int = 0, rng=None,
+):
+    """Returns (buf, final_length). buf: [1, prompt_len + max_new_tokens].
+
+    temperature == 0 (default) is the reference's greedy argmax; > 0
+    samples from softmax(logits / temperature), optionally truncated to
+    the top_k candidates (beyond-parity — the reference decodes greedily
+    only, utils.py:65). The step key folds the cursor into `rng`, so a
+    fixed seed reproduces exactly."""
     total = buf.shape[1]
     position_ids = jnp.broadcast_to(jnp.arange(total, dtype=jnp.int32), buf.shape)
 
@@ -46,7 +58,17 @@ def _decode_loop(params, cfg: gpt.GPTConfig, buf, prompt_len: int, max_new_token
     def body(carry):
         buf, cur, _ = carry
         logits = gpt.forward(params, cfg, buf, position_ids)
-        next_token = jnp.argmax(logits[0, cur - 1].astype(jnp.float32), axis=-1).astype(buf.dtype)
+        last = logits[0, cur - 1].astype(jnp.float32)
+        if temperature > 0.0:  # static branch: greedy decode trace unchanged
+            scaled = last / temperature
+            if top_k > 0:
+                kth = jax.lax.top_k(scaled, top_k)[0][-1]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            next_token = jax.random.categorical(
+                jax.random.fold_in(rng, cur), scaled
+            ).astype(buf.dtype)
+        else:
+            next_token = jnp.argmax(last, axis=-1).astype(buf.dtype)
         done = next_token == eos_id
         # Only append when not EOS — the reference breaks before appending
         # (utils.py:67-68), so EOS never enters the sequence.
@@ -169,8 +191,13 @@ def generate(
     tokenizer,
     max_new_tokens: int = 20,
     use_cache: bool | None = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    seed: int = 0,
 ) -> str:
-    """Greedy-decode a continuation of `prompt`. See module docstring."""
+    """Decode a continuation of `prompt`. Default is the reference's greedy
+    argmax; `temperature > 0` switches to softmax sampling (optionally
+    `top_k`-truncated), reproducible under `seed`. See module docstring."""
     # The reference truncates prompts at a hard 256 (utils.py:57). Also cap
     # at the position-embedding table so the whole buffer (prompt + new
     # tokens) stays in-range — beyond it, position lookups would silently
@@ -197,10 +224,25 @@ def generate(
         # chunk with its own expert-capacity window, which can diverge
         # from full-sequence routing (gpt._apply_moe_ffn docstring).
         use_cache = buf.shape[1] >= 512 and cfg.num_experts == 0
-    loop = _decode_loop_cached if use_cache else _decode_loop
-    buf, length = loop(
-        params, cfg, _replicate_like(params, buf), prompt_len, max_new_tokens, int(eos)
-    )
+    if temperature > 0.0:
+        # sampling runs the naive full-reforward loop only (the cached loop
+        # is greedy-only); an explicit use_cache=True is overridden
+        use_cache = False
+    if use_cache:
+        buf, length = _decode_loop_cached(
+            params, cfg, _replicate_like(params, buf), prompt_len,
+            max_new_tokens, int(eos),
+        )
+    else:
+        buf, length = _decode_loop(
+            params, cfg, _replicate_like(params, buf), prompt_len,
+            max_new_tokens, int(eos), temperature=float(temperature),
+            # lax.top_k rejects k beyond the logits width — clamp
+            top_k=min(int(top_k), cfg.padded_vocab_size),
+            rng=_replicate_like(params, np.asarray(jax.random.PRNGKey(seed)))
+            if temperature > 0.0
+            else None,
+        )
     out_ids = np.asarray(buf)[0, : int(length)]
     return tokenizer.decode(out_ids, skip_special_tokens=True)
 

@@ -35,6 +35,20 @@ def test_initialize_runtime_raises_on_explicit_coordinator(monkeypatch, fresh_ru
         mesh_lib.initialize_runtime()
 
 
+def test_initialize_runtime_rejects_half_set_identity_pair(monkeypatch, fresh_runtime):
+    """ADVICE r4: only one of JAX_NUM_PROCESSES / JAX_PROCESS_ID set must
+    fail with an error NAMING the missing variable — not an opaque failure
+    deep inside jax.distributed.initialize."""
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    called = []
+    monkeypatch.setattr(jax.distributed, "initialize", lambda *a, **k: called.append(1))
+    with pytest.raises(RuntimeError, match="JAX_PROCESS_ID"):
+        mesh_lib.initialize_runtime()
+    assert not called  # rejected before touching jax.distributed
+
+
 def test_initialize_runtime_tolerates_already_initialized(monkeypatch, fresh_runtime):
     monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
     monkeypatch.setattr(

@@ -25,12 +25,14 @@ LADDER = [
     # name, dim, heads, head_dim, layers, seq, batch, remat, scan
     # ("slice" = the 16-layer pipeline-stage slice DESIGN.md §2 profiles;
     #  full GPT-large/XL state does not fit one 16 GB chip at f32+Adam).
-    # batch sizes swept on the real chip 2026-07-30: for every rung the
-    # largest fitting batch won (remat keeps temp flat, so bigger batches
-    # just amortize the weight traffic better).
+    # batch sizes + layer-stack execution swept on the real chip
+    # 2026-07-30: the largest fitting batch won every rung (remat keeps
+    # temp flat, so bigger batches just amortize the weight traffic
+    # better); unrolled blocks beat the scanned stack on medium/large
+    # (+~1% MFU) while the xl slice measured better scanned.
     ("gpt-small-dim768", 768, 12, 64, 12, 512, 64, False, False),
-    ("gpt-medium-dim1024", 1024, 16, 64, 24, 512, 32, True, True),
-    ("gpt-large-slice-dim1280", 1280, 20, 64, 16, 512, 32, True, True),
+    ("gpt-medium-dim1024", 1024, 16, 64, 24, 512, 32, True, False),
+    ("gpt-large-slice-dim1280", 1280, 20, 64, 16, 512, 32, True, False),
     ("gpt-xl-slice-dim1600", 1600, 25, 64, 16, 512, 32, True, True),
 ]
 

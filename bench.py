@@ -13,7 +13,6 @@ Prints exactly ONE JSON line:
 
 import json
 import sys
-import time
 
 import numpy as np
 
@@ -22,6 +21,7 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from tools.bench_ladder import make_batch, run_ladder, time_windows
     from tpukit.model import GPTConfig
     from tpukit.profiling import peak_flops_per_chip, train_flops_per_token
     from tpukit.shardings import DataParallel, SingleDevice
@@ -50,21 +50,7 @@ def main():
     state = jax.device_put(state, state_sharding)
 
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, size=(batch, seq - 1)).astype(np.int32)
-    model_batch = {
-        "input_ids": ids,
-        "position_ids": np.ascontiguousarray(
-            np.broadcast_to(np.arange(seq - 1, dtype=np.int32), ids.shape)
-        ),
-        "mask": np.zeros_like(ids, dtype=bool),
-    }
-    targets = np.roll(ids, -1, axis=1).astype(np.int32)
-
-    # warmup / compile (float() forces a real host sync — block_until_ready
-    # is insufficient on tunneled PJRT backends)
-    for _ in range(3):
-        state, loss = train_step(state, model_batch, targets)
-    final_loss = float(loss)
+    model_batch, targets = make_batch(rng, cfg.vocab_size, batch, seq - 1)
 
     # Best of four timing windows: the shared/tunneled chip shows double-
     # digit run-to-run variance from external load; the fastest window is
@@ -72,13 +58,9 @@ def main():
     # are kept so the JSON can report the spread (VERDICT r4: a headline
     # that sits on the target bar needs its noise band stated).
     steps = 12
-    windows = []
-    for _ in range(4):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, loss = train_step(state, model_batch, targets)
-        final_loss = float(loss)
-        windows.append(time.perf_counter() - t0)
+    windows, state, final_loss = time_windows(
+        train_step, state, model_batch, targets, steps=steps, windows=4
+    )
     best = min(windows)
 
     tokens = steps * batch * (seq - 1)
@@ -102,26 +84,12 @@ def main():
         shapes = jax.eval_shape(lambda: state)
         train_step_l, _, sharding_l = make_step_fns(cfg_long, optimizer, strategy, shapes)
         state = jax.device_put(state, sharding_l)
-        ids = rng.randint(0, cfg.vocab_size, size=(long_batch, long_seq)).astype(np.int32)
-        long_b = {
-            "input_ids": ids,
-            "position_ids": np.ascontiguousarray(
-                np.broadcast_to(np.arange(long_seq, dtype=np.int32), ids.shape)
-            ),
-            "mask": np.zeros_like(ids, dtype=bool),
-        }
-        long_t = np.roll(ids, -1, axis=1).astype(np.int32)
-        for _ in range(2):
-            state, loss_l = train_step_l(state, long_b, long_t)
-        float(loss_l)
-        best_l = float("inf")
-        for _ in range(4):  # best-of-4 windows of 8 steps: the shared
-            t0 = time.perf_counter()  # chip's variance needs the extra shots
-            for _ in range(8):
-                state, loss_l = train_step_l(state, long_b, long_t)
-            float(loss_l)
-            best_l = min(best_l, time.perf_counter() - t0)
-        long_tps = 8 * long_batch * long_seq / best_l / n_dev
+        long_b, long_t = make_batch(rng, cfg.vocab_size, long_batch, long_seq)
+        # best-of-4 windows of 8: the shared chip's variance needs the shots
+        times_l, state, _ = time_windows(
+            train_step_l, state, long_b, long_t, steps=8, windows=4, warmup=2
+        )
+        long_tps = 8 * long_batch * long_seq / min(times_l) / n_dev
     except Exception as exc:  # stdout is reserved for the JSON line; the
         # error ALSO lands in the JSON so a kernel regression cannot hide
         # behind a clean rc=0 with null fields (VERDICT r4 #8)
@@ -145,23 +113,39 @@ def main():
             state_o = jax.device_put(state_o, sh_o)
             kinds = {l.sharding.memory_kind for l in jax.tree.leaves(state_o.params)}
             assert kinds == {"pinned_host"}, kinds
-            for _ in range(2):
-                state_o, loss_o = step_o(state_o, model_batch, targets)
-            float(loss_o)
-            t0 = time.perf_counter()
-            for _ in range(6):
-                state_o, loss_o = step_o(state_o, model_batch, targets)
-            float(loss_o)
-            dt = time.perf_counter() - t0
+            times_o, state_o, _ = time_windows(
+                step_o, state_o, model_batch, targets, steps=6, windows=1, warmup=2
+            )
             kinds = {l.sharding.memory_kind for l in jax.tree.leaves(state_o.params)}
             assert kinds == {"pinned_host"}, kinds
             offload_ok = True
-            offload_tps = 6 * batch * (seq - 1) / dt / n_dev
+            offload_tps = 6 * batch * (seq - 1) / times_o[0] / n_dev
             del state_o
     except Exception as exc:
         offload_ok = False
         offload_err = repr(exc)
         print(f"fsdp cpu_offload probe failed: {exc!r}", file=sys.stderr)
+
+    # MoE probe (round 5): the Switch-style expert path on the real chip —
+    # reference shape with 8 experts, full train step (routing + dispatch
+    # einsums + aux loss + AdamW).
+    moe_tps, moe_err = None, None
+    try:
+        cfg_moe = cfg.replace(num_experts=8)
+        state_m = create_train_state(jax.random.PRNGKey(0), cfg_moe, optimizer)
+        shapes_m = jax.eval_shape(lambda: state_m)
+        step_m, _, sh_m = make_step_fns(cfg_moe, optimizer, strategy, shapes_m)
+        state_m = jax.device_put(state_m, sh_m)
+        moe_batch = 32 * n_dev
+        b_m, t_m = make_batch(rng, cfg.vocab_size, moe_batch, seq - 1)
+        times_m, state_m, _ = time_windows(
+            step_m, state_m, b_m, t_m, steps=8, windows=3, warmup=2
+        )
+        moe_tps = 8 * moe_batch * (seq - 1) / min(times_m) / n_dev
+        del state_m
+    except Exception as exc:
+        moe_err = repr(exc)
+        print(f"moe probe failed: {exc!r}", file=sys.stderr)
 
     # Ladder rungs (VERDICT r4 #1): single-chip measurements of the
     # BASELINE configs 2-5 shapes at head_dim=64 — GPT-small/medium full,
@@ -170,8 +154,6 @@ def main():
     ladder = None
     if n_dev == 1:  # rung batch sizes are tuned per chip
         try:
-            from tools.bench_ladder import run_ladder
-
             ladder = run_ladder(steps=6, windows=3)
         except Exception as exc:
             ladder = [{"shape": "ladder", "error": repr(exc)}]
@@ -194,6 +176,8 @@ def main():
         "fsdp_cpu_offload_ok": offload_ok,
         "fsdp_cpu_offload_tokens_per_sec_per_chip": round(offload_tps, 1) if offload_tps else None,
         "fsdp_cpu_offload_error": offload_err,
+        "moe_e8_tokens_per_sec_per_chip": round(moe_tps, 1) if moe_tps else None,
+        "moe_error": moe_err,
         "ladder": ladder,
         "chips": n_dev,
         "device": jax.devices()[0].device_kind,

@@ -37,6 +37,42 @@ LADDER = [
 ]
 
 
+def make_batch(rng, vocab: int, batch: int, seq: int):
+    """Synthetic (model_batch, targets) in the trainer's input format —
+    the ONE batch builder every bench/probe in bench.py and this tool
+    shares."""
+    ids = rng.randint(0, vocab, size=(batch, seq)).astype(np.int32)
+    model_batch = {
+        "input_ids": ids,
+        "position_ids": np.ascontiguousarray(
+            np.broadcast_to(np.arange(seq, dtype=np.int32), ids.shape)
+        ),
+        "mask": np.zeros_like(ids, dtype=bool),
+    }
+    return model_batch, np.roll(ids, -1, axis=1).astype(np.int32)
+
+
+def time_windows(step_fn, state, model_batch, targets, steps: int,
+                 windows: int, warmup: int = 3):
+    """Warm up (compile), then time `windows` windows of `steps` steps.
+    Returns (window_times, state, last_loss). The shared/tunneled chip
+    shows double-digit run-to-run variance, so callers report min(times)
+    as steady-state and may report the spread as the noise band. float()
+    forces a real host sync — block_until_ready is insufficient on
+    tunneled PJRT backends."""
+    for _ in range(warmup):
+        state, loss = step_fn(state, model_batch, targets)
+    last = float(loss)
+    times = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = step_fn(state, model_batch, targets)
+        last = float(loss)
+        times.append(time.perf_counter() - t0)
+    return times, state, last
+
+
 def bench_shape(name, dim, heads, head_dim, layers, seq, batch, remat, scan,
                 steps=8, windows=3):
     import jax
@@ -64,28 +100,11 @@ def bench_shape(name, dim, heads, head_dim, layers, seq, batch, remat, scan,
     train_step, _, state_sharding = make_step_fns(cfg, optimizer, SingleDevice(), shapes)
     state = jax.device_put(state, state_sharding)
 
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
-    model_batch = {
-        "input_ids": ids,
-        "position_ids": np.ascontiguousarray(
-            np.broadcast_to(np.arange(seq, dtype=np.int32), ids.shape)
-        ),
-        "mask": np.zeros_like(ids, dtype=bool),
-    }
-    targets = np.roll(ids, -1, axis=1).astype(np.int32)
-
-    for _ in range(2):
-        state, loss = train_step(state, model_batch, targets)
-    float(loss)  # host sync (block_until_ready is a no-op on tunneled PJRT)
-
-    best = float("inf")
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, loss = train_step(state, model_batch, targets)
-        float(loss)
-        best = min(best, time.perf_counter() - t0)
+    model_batch, targets = make_batch(np.random.RandomState(0), cfg.vocab_size, batch, seq)
+    times, state, _ = time_windows(
+        train_step, state, model_batch, targets, steps, windows, warmup=2
+    )
+    best = min(times)
 
     tps = steps * batch * seq / best
     fpt = train_flops_per_token(cfg, seq)
@@ -95,7 +114,8 @@ def bench_shape(name, dim, heads, head_dim, layers, seq, batch, remat, scan,
     return {
         "shape": name,
         "config": f"dim{dim} hd{head_dim}x{heads} L{layers} seq{seq} b{batch}"
-                  + (" remat" if remat else ""),
+                  + (" remat" if remat else "")
+                  + (" scanned" if scan else " unrolled"),
         "tokens_per_sec_per_chip": round(tps, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "step_ms": round(best / steps * 1e3, 2),

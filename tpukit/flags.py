@@ -75,8 +75,10 @@ class TrainFlags:
     # memory bounded by the stage count instead of the micro count).
     pipeline_schedule: str = "gpipe"
     # main-moe.py only: number of routed experts replacing each layer's FFN
-    # (Switch-style top-1 routing; 0 = the dense reference model).
+    # (0 = the dense reference model) and how many experts each token
+    # routes to (1 = Switch, 2 = GShard/Mixtral-style top-2).
     num_experts: int = 0
+    moe_top_k: int = 1
 
 
 # The canonical 12 flags of every reference recipe (main-single.py:156-167).
@@ -119,6 +121,7 @@ def build_parser(
         )
     if num_experts:
         parser.add_argument("--num_experts", type=int, default=8)
+        parser.add_argument("--moe_top_k", type=int, default=1)
     parser.add_argument("--seed", type=int, default=defaults.seed)
     parser.add_argument("--dropout", type=float, default=defaults.dropout)
     parser.add_argument("--checkpoint_every", type=int, default=defaults.checkpoint_every)
@@ -155,4 +158,5 @@ def parse_flags(
     kw.setdefault("cp_attention", "ring")
     kw.setdefault("pipeline_schedule", "gpipe")
     kw.setdefault("num_experts", 0)
+    kw.setdefault("moe_top_k", 1)
     return TrainFlags(**kw)

@@ -121,6 +121,18 @@ class GPTConfig:
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # routed experts per token: 1 = Switch (default), 2 = GShard/Mixtral-
+    # style top-2. Gates stay the RAW router probabilities (GShard
+    # convention) so top_k=1 is bit-identical to the Switch path.
+    router_top_k: int = 1
+
+    def __post_init__(self):
+        if self.num_experts > 0 and not (1 <= self.router_top_k <= self.num_experts):
+            raise ValueError(
+                f"router_top_k={self.router_top_k} must be in [1, "
+                f"num_experts={self.num_experts}] — silently clamping would "
+                f"train a different routing than the one requested"
+            )
 
     @property
     def inner_dim(self) -> int:
@@ -248,7 +260,8 @@ def _apply_feed_forward(layer, cfg: GPTConfig, x, rng, deterministic):
 
 
 def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic):
-    """Switch-style top-1 mixture-of-experts FFN. Returns (out, aux).
+    """Routed mixture-of-experts FFN: Switch-style top-1 by default,
+    GShard/Mixtral-style top-k via cfg.router_top_k. Returns (out, aux).
 
     TPU-first design: STATIC shapes throughout — tokens dispatch into a
     fixed `[E, B, capacity, dim]` buffer via one-hot einsums, each expert
@@ -278,13 +291,20 @@ def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic):
     batch, seq_len, dim = x.shape
     experts = layer["ffn"]["experts"]
     n_exp = cfg.num_experts
-    # Derived from the STATIC position-table size (width invariance), then
-    # clamped to the call width: a row position can never reach seq_len, so
-    # the clamp is output-identical while keeping short decode buffers from
+    # Derived from the STATIC position-table size (width invariance) and
+    # scaled by the routed-experts count (top-k generates k*S assignments
+    # per row — the GShard convention; without the factor, top-2 would
+    # drop ~37% of second choices even at perfect balance), then clamped
+    # to the call width: a row position can never reach seq_len, so the
+    # clamp is output-identical while keeping short decode buffers from
     # paying full-table-sized dispatch/combine einsums.
+    top_k = cfg.router_top_k
     capacity = max(
         1,
-        int(-(-cfg.max_position_embeddings * cfg.expert_capacity_factor // n_exp)),
+        int(
+            -(-cfg.max_position_embeddings * top_k * cfg.expert_capacity_factor
+              // n_exp)
+        ),
     )
     capacity = min(capacity, seq_len)
 
@@ -294,9 +314,12 @@ def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic):
         layer["ffn"]["router"]["kernel"].astype(jnp.float32),
     )
     probs = jax.nn.softmax(logits, axis=-1)  # [B, S, E] f32
-    gate = jnp.max(probs, axis=-1)  # top-1 router prob
-    choice = jnp.argmax(probs, axis=-1)
-    assign = jax.nn.one_hot(choice, n_exp, dtype=jnp.float32)  # [B, S, E]
+    top_vals, top_idx = jax.lax.top_k(probs, top_k)  # [B, S, K]
+    # per-(token, expert) assignment and raw-probability gates; the k
+    # chosen experts are distinct, so the one-hot sum stays 0/1-valued
+    choice_oh = jax.nn.one_hot(top_idx, n_exp, dtype=jnp.float32)  # [B, S, K, E]
+    assign = jnp.sum(choice_oh, axis=2)  # [B, S, E]
+    gate_map = jnp.sum(top_vals[..., None] * choice_oh, axis=2)  # [B, S, E]
 
     # position of each token in its expert's per-row buffer (cumsum along
     # the sequence is causal: later tokens never evict earlier ones);
@@ -317,10 +340,16 @@ def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic):
         "ebcf,efd->ebcd", h, experts["down"]["kernel"].astype(cfg.compute_dtype)
     ) + experts["down"]["bias"].astype(cfg.compute_dtype)[:, None, None, :]
     h = jax.nn.relu(h)
-    combined = jnp.einsum("ebcd,bsec->bsd", h, dispatch)
-    out = combined * gate.astype(cfg.compute_dtype)[..., None]
+    # combine weighted by each (token, expert)'s gate — for top_k=1 this
+    # is the Switch combine exactly (one expert, raw top prob)
+    out = jnp.einsum(
+        "ebcd,bsec->bsd", h,
+        dispatch * gate_map.astype(cfg.compute_dtype)[..., None],
+    )
 
-    frac_tokens = jnp.mean(assign, axis=1)  # [B, E]
+    # Switch load-balance terms; /top_k keeps frac_tokens a distribution
+    # (each token contributes k assignments)
+    frac_tokens = jnp.mean(assign, axis=1) / top_k  # [B, E]
     mean_prob = jnp.mean(probs, axis=1)  # [B, E]
     aux = n_exp * jnp.mean(jnp.sum(frac_tokens * mean_prob, axis=-1))
     return dropout(out, cfg.dropout, rng, deterministic), aux

@@ -302,6 +302,7 @@ def fit(
         remat_layers=flags.remat,
         scan_layers=flags.scan_layers,
         num_experts=flags.num_experts,
+        router_top_k=flags.moe_top_k,
     )
     optimizer = make_optimizer(flags.learning_rate)
     strategy.validate_config(cfg)  # fail fast with a clear shape/mesh error

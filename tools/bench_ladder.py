@@ -10,8 +10,9 @@ use head_dim >= 64, the regime where the MXU contraction is not structurally
 capped (DESIGN.md §5: head_dim=32 pins attention matmuls at ~25% of peak).
 
 Usage: python tools/bench_ladder.py [--only NAME] [--batch N] [--steps N]
-Prints one JSON line per shape; `python bench.py` embeds the same
-measurements in the driver-facing JSON via bench.run_ladder().
+Prints one JSON line per shape; `python bench.py` imports `run_ladder`
+(and the shared `make_batch`/`time_windows` harness) from here and embeds
+the same measurements in the driver-facing JSON.
 """
 
 import argparse

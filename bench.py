@@ -23,7 +23,7 @@ def main():
 
     from tools.bench_ladder import make_batch, run_ladder, time_windows
     from tpukit.model import GPTConfig
-    from tpukit.profiling import peak_flops_per_chip, train_flops_per_token
+    from tpukit.obs import peak_flops_per_chip, train_flops_per_token
     from tpukit.shardings import DataParallel, SingleDevice
     from tpukit.train import create_train_state, make_optimizer, make_step_fns
 
@@ -51,6 +51,17 @@ def main():
 
     rng = np.random.RandomState(0)
     model_batch, targets = make_batch(rng, cfg.vocab_size, batch, seq - 1)
+
+    # XLA static analysis of the exact executable the timing loop runs
+    # (tpukit.obs round 6): the AOT lower/compile shares the jit caches, so
+    # this is not a second compile; FLOPs/bytes come from cost_analysis and
+    # comm bytes are parsed from the compiled HLO's collectives.
+    from tpukit.obs import compiled_stats
+
+    struct = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+    xla_stats = compiled_stats(
+        train_step, shapes, jax.tree.map(struct, model_batch), struct(targets)
+    )
 
     # Best of four timing windows: the shared/tunneled chip shows double-
     # digit run-to-run variance from external load; the fastest window is
@@ -183,6 +194,8 @@ def main():
         "device": jax.devices()[0].device_kind,
         "config": f"GPT-20M dim256 L8 seq256 bf16 batch{batch}, fused train step",
         "final_loss": round(final_loss, 4),
+        # roofline + comm-volume telemetry for the headline step (tpukit.obs)
+        "xla_train_step": xla_stats,
     }
     print(json.dumps(result))
 

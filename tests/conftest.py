@@ -19,7 +19,12 @@ import jax  # noqa: E402
 # env var alone is too late, but the config flag still wins as long as no
 # backend has been initialized yet.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices config option; the XLA_FLAGS
+    # host-platform device count set above covers those versions.
+    pass
 jax.config.update("jax_threefry_partitionable", True)
 
 # Persistent compile cache: repeat test runs skip recompilation.

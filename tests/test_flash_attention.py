@@ -262,7 +262,7 @@ def test_flash_inside_shard_map():
     """The pipeline recipes call attention inside a Manual shard_map region;
     the kernel must compose there as well."""
     import jax.sharding as jsh
-    from jax import shard_map
+    from tpukit.compat import shard_map
 
     mesh = _dp_mesh()
     rng = np.random.RandomState(5)

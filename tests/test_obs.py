@@ -1,0 +1,628 @@
+"""Tests for the round-6 telemetry subsystem (tpukit/obs) + its satellites.
+
+Covers the four pillars on the virtual CPU mesh: span-timeline accounting
+(seconds sum to wall clock, goodput in (0, 1]), XLA static analysis of a
+compiled DP train step (FLOPs, memory, all-reduce comm bytes from the
+HLO), in-jit grad norms vs an eager reference, the loss-spike/NaN sentinel,
+heartbeat liveness files, and the end-to-end `fit()` JSONL contract that
+`tools/report.py` renders. Satellite regressions ride along: the analytic
+loader schedule vs brute-force enumeration, the fail-loud sampling cache
+check, and `time_windows(warmup=0)`.
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from tpukit.obs import (
+    Heartbeat,
+    SpanTimeline,
+    SpikeSentinel,
+    collective_bytes,
+    compiled_stats,
+    format_breakdown,
+)
+
+
+# ---------------------------------------------------------------------------
+# span timeline
+# ---------------------------------------------------------------------------
+
+
+def test_span_timeline_sums_to_wall_clock():
+    tl = SpanTimeline()
+    with tl.span("step"):
+        time.sleep(0.02)
+    with tl.span("data"):
+        time.sleep(0.01)
+    with tl.span("sync"):
+        time.sleep(0.01)
+    time.sleep(0.005)  # unattributed -> "other"
+    win = tl.window()
+    assert win["total_s"] >= 0.045
+    assert abs(sum(win["seconds"].values()) - win["total_s"]) < 1e-6
+    assert abs(sum(win["fractions"].values()) - 1.0) < 1e-6
+    assert 0.0 < win["goodput"] <= 1.0
+    # goodput is exactly the step+sync share
+    assert win["goodput"] == pytest.approx(
+        win["fractions"]["step"] + win["fractions"]["sync"]
+    )
+    assert win["seconds"]["other"] >= 0.004
+    # window() resets: an immediate second window is ~empty
+    win2 = tl.window()
+    assert win2["seconds"].get("step", 0.0) == 0.0
+
+
+def test_nested_spans_attribute_to_outer_only():
+    tl = SpanTimeline()
+    with tl.span("eval"):
+        with tl.span("telemetry"):  # e.g. capture_xla inside the eval phase
+            time.sleep(0.01)
+    win = tl.window()
+    assert "telemetry" not in win["seconds"]
+    assert win["seconds"]["eval"] >= 0.009
+
+
+def test_epoch_breakdown_spans_windows():
+    tl = SpanTimeline()
+    with tl.span("step"):
+        time.sleep(0.01)
+    tl.window()
+    with tl.span("step"):
+        time.sleep(0.01)
+    ep = tl.epoch()  # covers both windows
+    assert ep["seconds"]["step"] >= 0.018
+    assert abs(sum(ep["seconds"].values()) - ep["total_s"]) < 1e-6
+    assert "goodput" in format_breakdown(ep)
+
+
+# ---------------------------------------------------------------------------
+# XLA static analysis
+# ---------------------------------------------------------------------------
+
+
+def test_collective_bytes_parses_hlo():
+    hlo = """
+  %ar = f32[8,128]{1,0} all-reduce(f32[8,128]{1,0} %x), replica_groups={}
+  %t = (f32[16]{0}, bf16[4,4]{1,0}) all-reduce(%a, %b), channel_id=1
+  %ag = bf16[64,32]{1,0} all-gather(bf16[8,32]{1,0} %y), dimensions={0}
+  %cp = f32[2,2]{1,0} collective-permute-start(f32[2,2]{1,0} %z)
+  %cpd = f32[2,2]{1,0} collective-permute-done(f32[2,2]{1,0} %cp)
+  %rs = f32[8]{0} reduce-scatter(f32[64]{0} %w), dimensions={0}
+"""
+    got = collective_bytes(hlo)
+    assert got["all-reduce"]["count"] == 2
+    assert got["all-reduce"]["bytes"] == 8 * 128 * 4 + 16 * 4 + 16 * 2
+    assert got["all-gather"] == {"count": 1, "bytes": 64 * 32 * 2}
+    # async pairs count once (the -start; -done carries no new payload)
+    assert got["collective-permute"] == {"count": 1, "bytes": 16}
+    assert got["reduce-scatter"] == {"count": 1, "bytes": 32}
+    assert collective_bytes("%a = f32[2] add(%b, %c)") == {}
+
+
+def test_collective_bytes_counts_async_result_half_only():
+    """TPU-optimized HLO emits async pairs whose -start result tuple
+    carries (operands..., results..., ctx scalars...): only the results
+    half is moved volume — summing the whole tuple would double it."""
+    hlo = """
+  %ag = (bf16[4,64]{1,0}, bf16[8,64]{1,0}) all-gather-start(bf16[4,64]{1,0} %x)
+  %agd = bf16[8,64]{1,0} all-gather-done((bf16[4,64]{1,0}, bf16[8,64]{1,0}) %ag)
+  %cp = (f32[8,128]{1,0}, f32[8,128]{1,0}, u32[], u32[]) collective-permute-start(f32[8,128]{1,0} %y)
+  %ar = (f32[16]{0}, bf16[4]{0}) all-reduce-start(%a, %b)
+"""
+    got = collective_bytes(hlo)
+    assert got["all-gather"] == {"count": 1, "bytes": 8 * 64 * 2}  # post-gather
+    assert got["collective-permute"] == {"count": 1, "bytes": 8 * 128 * 4}
+    # all-reduce-start's tuple holds ONLY results (XLA's combiner fuses
+    # buffers into one variadic all-reduce) — never halved
+    assert got["all-reduce"] == {"count": 1, "bytes": 16 * 4 + 4 * 2}
+
+
+def _batch_structs(batch_size, seq):
+    batch = {
+        "input_ids": jax.ShapeDtypeStruct((batch_size, seq), np.int32),
+        "position_ids": jax.ShapeDtypeStruct((batch_size, seq), np.int32),
+        "mask": jax.ShapeDtypeStruct((batch_size, seq), np.bool_),
+    }
+    return batch, jax.ShapeDtypeStruct((batch_size, seq), np.int32)
+
+
+def test_compiled_stats_on_cpu_mesh(tiny_config):
+    """Acceptance: cost/memory analysis + comm bytes captured on the CPU
+    mesh — the DP grad psum must surface as all-reduce traffic."""
+    from tpukit.shardings import DataParallel
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    opt = make_optimizer(1e-3)
+    strat = DataParallel()
+    state_shapes = jax.eval_shape(
+        lambda: create_train_state(jax.random.PRNGKey(0), tiny_config, opt)
+    )
+    step, _, _ = make_step_fns(tiny_config, opt, strat, state_shapes)
+    batch, targets = _batch_structs(8, 16)
+    stats = compiled_stats(step, state_shapes, batch, targets)
+    assert stats is not None
+    assert stats["flops"] is not None and stats["flops"] > 0
+    assert stats["bytes_accessed"] is not None and stats["bytes_accessed"] > 0
+    coll = stats["collectives"]
+    assert coll and "all-reduce" in coll
+    assert coll["all-reduce"]["count"] >= 1
+    assert coll["all-reduce"]["bytes"] > 0
+    # XLA:CPU supports memory_analysis (tools/pipeline_memory.py relies on
+    # it); peak estimate must cover at least the argument (state) bytes
+    mem = stats["memory"]
+    assert mem is not None
+    assert mem["temp_size_in_bytes"] >= 0
+    assert mem["peak_bytes_estimate"] > 0
+
+
+def test_compiled_stats_is_none_on_lowering_failure():
+    assert compiled_stats(jax.jit(lambda x: x)) is None  # missing avals
+
+
+# ---------------------------------------------------------------------------
+# grad-norm sentinels (in-jit half)
+# ---------------------------------------------------------------------------
+
+
+def _train_batch(rng, cfg, batch_size=8, seq=16):
+    ids = rng.randint(3, cfg.vocab_size, size=(batch_size, seq)).astype(np.int32)
+    batch = {
+        "input_ids": ids,
+        "position_ids": np.broadcast_to(
+            np.arange(seq, dtype=np.int32), ids.shape
+        ).copy(),
+        "mask": np.zeros_like(ids, dtype=bool),
+    }
+    return batch, np.roll(ids, -1, axis=1).astype(np.int32)
+
+
+def test_grad_norms_match_eager_reference(tiny_config, rng):
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    cfg = tiny_config
+    opt = make_optimizer(1e-3)
+    strat = SingleDevice()
+    state = create_train_state(jax.random.PRNGKey(0), cfg, opt)
+    shapes = jax.eval_shape(lambda: state)
+    step, _, _ = make_step_fns(cfg, opt, strat, shapes, log_grad_norms=True)
+    batch, targets = _train_batch(rng, cfg)
+
+    # reference grads on the PRE-step params (copied before donation)
+    params_before = jax.tree.map(np.asarray, state.params)
+    ref_grads = jax.jit(
+        jax.grad(lambda p: strat.loss_fn(p, cfg, batch, targets)[0])
+    )(params_before)
+    ref_norm = float(optax.global_norm(ref_grads))
+
+    new_state, loss, norms = step(state, batch, targets)
+    assert set(norms) == {"grad_norm", "update_norm", "param_norm"}
+    assert float(norms["grad_norm"]) == pytest.approx(ref_norm, rel=1e-4)
+    # param_norm is the POST-update parameter norm
+    assert float(norms["param_norm"]) == pytest.approx(
+        float(optax.global_norm(new_state.params)), rel=1e-5
+    )
+    assert float(norms["update_norm"]) > 0.0
+    assert np.isfinite(float(loss))
+
+
+def test_train_step_unchanged_without_norm_flag(tiny_config):
+    """Flag off -> the step's output arity (and traced graph) is exactly the
+    pre-telemetry one; flag on only APPENDS the norms dict."""
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    opt = make_optimizer(1e-3)
+    shapes = jax.eval_shape(
+        lambda: create_train_state(jax.random.PRNGKey(0), tiny_config, opt)
+    )
+    batch, targets = _batch_structs(4, 16)
+    step_off, _, _ = make_step_fns(tiny_config, opt, SingleDevice(), shapes)
+    step_on, _, _ = make_step_fns(
+        tiny_config, opt, SingleDevice(), shapes, log_grad_norms=True
+    )
+    out_off = jax.eval_shape(step_off, shapes, batch, targets)
+    out_on = jax.eval_shape(step_on, shapes, batch, targets)
+    assert len(out_off) == 2
+    assert len(out_on) == 3 and set(out_on[2]) == {
+        "grad_norm", "update_norm", "param_norm",
+    }
+
+
+# ---------------------------------------------------------------------------
+# loss-spike sentinel (host half)
+# ---------------------------------------------------------------------------
+
+
+def test_spike_sentinel_fires_on_injected_spike():
+    s = SpikeSentinel(threshold=3.0, min_history=4)
+    for i in range(8):  # steady-ish baseline
+        assert s.observe(2.0 + 0.01 * (i % 2), step=i) is None
+    ev = s.observe(5.0, step=8)
+    assert ev is not None and ev.kind == "spike" and ev.step == 8
+    assert ev.loss == 5.0 and 1.9 < ev.mean < 2.1
+    # the spike was not absorbed into the baseline: a sustained divergence
+    # keeps firing
+    assert s.observe(5.0, step=9) is not None
+    rec = ev.record()
+    assert rec["event"] == "spike" and "kind" not in rec
+
+
+def test_spike_sentinel_fires_on_nan_and_inf():
+    s = SpikeSentinel(threshold=3.0)
+    assert s.observe(float("nan"), step=1).kind == "nan"
+    assert s.observe(float("inf"), step=2).kind == "nan"
+
+
+def test_spike_sentinel_quiet_on_descent_and_noise():
+    s = SpikeSentinel(threshold=3.0)
+    rng = np.random.RandomState(0)
+    loss = 6.0
+    for i in range(64):  # normal training: decreasing + noise
+        loss = loss * 0.99 + rng.randn() * 0.01
+        assert s.observe(loss, step=i) is None
+
+
+def test_spike_sentinel_rejects_bad_threshold():
+    with pytest.raises(ValueError, match="threshold"):
+        SpikeSentinel(threshold=0.0)
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_write_check_and_stragglers(tmp_path):
+    h0 = Heartbeat(tmp_path, process_index=0, process_count=3, timeout_s=60)
+    h1 = Heartbeat(tmp_path, process_index=1, process_count=3, timeout_s=60)
+    h0.beat(10)
+    h1.beat(8)
+    beats = h0.read_all()
+    assert set(beats) == {0, 1}
+    assert beats[0]["step"] == 10 and beats[1]["step"] == 8
+
+    # process 2 never wrote
+    stragglers = h0.check()
+    assert [(s["process"], s["reason"]) for s in stragglers] == [(2, "missing")]
+
+    # everything is stale an hour later
+    stale = h0.check(now=time.time() + 3600)
+    assert {s["process"] for s in stale} == {0, 1, 2}
+    assert {s["reason"] for s in stale} == {"stale", "missing"}
+
+    # step lag: process 2 alive but far behind
+    h2 = Heartbeat(tmp_path, process_index=2, process_count=3, timeout_s=60)
+    h2.beat(1)
+    lag = h0.check(step_lag=5)
+    assert [(s["process"], s["reason"]) for s in lag] == [(2, "lagging")]
+    assert lag[0]["behind"] == 9
+
+    # torn/foreign files are skipped, never raised on
+    (tmp_path / "heartbeat-p00099.json").write_text("{not json")
+    assert set(h0.read_all()) == {0, 1, 2}
+
+
+def test_heartbeat_timeout_scales_with_beat_cadence(tmp_path):
+    """Beats land once per PRINT_FREQ window; when a big-model window is
+    longer than the fixed timeout, the checker must scale its staleness
+    threshold from the observed cadence instead of flagging every healthy
+    peer on every check."""
+    h = Heartbeat(tmp_path, process_index=0, process_count=1, timeout_s=10)
+    t0 = 1_000_000.0
+    h.beat(1, now=t0)
+    h.beat(2, now=t0 + 100)  # observed window cadence 100s >> timeout 10s
+    # 150s-old beat is healthy under the 3x-cadence threshold (300s)...
+    assert h.check(now=t0 + 250) == []
+    # ...but past it the stale report still fires
+    stale = h.check(now=t0 + 100 + 301)
+    assert [s["reason"] for s in stale] == ["stale"]
+
+
+# ---------------------------------------------------------------------------
+# loader satellite: analytic global schedule == brute-force enumeration
+# ---------------------------------------------------------------------------
+
+
+def _make_dataset(n, seq=8):
+    from tpukit.data import ArrayDataset
+
+    ids = np.arange(n * seq, dtype=np.int32).reshape(n, seq) % 97 + 3
+    return ArrayDataset(ids, np.ones_like(ids))
+
+
+@pytest.mark.parametrize("pad_mode", ["wrap", "empty"])
+@pytest.mark.parametrize("drop_last", [False, True])
+@pytest.mark.parametrize(
+    "n,reps,bs",
+    [(253, 2, 32), (64, 1, 16), (64, 2, 8), (100, 3, 8), (7, 4, 4), (33, 8, 2), (5, 2, 8)],
+)
+def test_global_real_row_counts_matches_enumeration(n, reps, bs, drop_last, pad_mode):
+    from tpukit.loader import DataLoader
+
+    ds = _make_dataset(n)
+    loaders = [
+        DataLoader(
+            ds, bs, shuffle=True, seed=7, num_replicas=reps, rank=r,
+            drop_last=drop_last, pad_to_batch=True, pad_mode=pad_mode,
+        )
+        for r in range(reps)
+    ]
+    for epoch in (0, 3):  # schedule must be shuffle-epoch-invariant
+        for ld in loaders:
+            ld.set_epoch(epoch)
+        analytic = loaders[0].global_real_row_counts()
+        # brute force: enumerate every rank's real mask per batch
+        brute = None
+        for ld in loaders:
+            _, real = ld._indices()
+            stop = (len(real) // bs) * bs if drop_last else len(real)
+            per = np.array(
+                [real[s : s + bs].sum() for s in range(0, stop, bs)], np.int64
+            )
+            brute = per if brute is None else brute + per
+        np.testing.assert_array_equal(analytic, brute)
+        if not drop_last:
+            assert int(analytic.sum()) == n  # every original row exactly once
+
+
+def test_global_real_row_counts_respects_subclass_schedule():
+    """ADVICE r5 #3: a subclass overriding `_indices` must not silently get
+    the base-class closed form — the method falls back to enumerating the
+    subclass's actual schedule."""
+    from tpukit.loader import DataLoader
+
+    class HalfLoader(DataLoader):
+        # keeps only the first half of the dataset (custom schedule)
+        def _indices(self):
+            idx, real = super()._indices()
+            keep = len(self.dataset) // (2 * self.num_replicas)
+            return idx[:keep], real[:keep]
+
+    ds = _make_dataset(64)
+    loaders = [
+        HalfLoader(ds, 8, shuffle=True, seed=3, num_replicas=2, rank=r)
+        for r in range(2)
+    ]
+    analytic = loaders[0].global_real_row_counts()
+    brute = None
+    for ld in loaders:
+        _, real = ld._indices()
+        per = np.array(
+            [real[s : s + 8].sum() for s in range(0, len(real), 8)], np.int64
+        )
+        brute = per if brute is None else brute + per
+    np.testing.assert_array_equal(analytic, brute)
+    assert int(analytic.sum()) == 32  # half of 64, not the base schedule's 64
+
+
+def test_global_real_row_counts_agrees_with_iterated_real_rows():
+    """The schedule must match what the loaders actually YIELD (the
+    real_rows field the meter consumes)."""
+    from tpukit.loader import DataLoader
+
+    ds = _make_dataset(253)
+    loaders = [
+        DataLoader(
+            ds, 32, shuffle=True, seed=1, num_replicas=2, rank=r,
+            pad_to_batch=True,
+        )
+        for r in range(2)
+    ]
+    for ld in loaders:
+        ld.set_epoch(2)
+    analytic = loaders[0].global_real_row_counts()
+    yielded = [
+        np.array([b["real_rows"] for b in ld], dtype=np.int64) for ld in loaders
+    ]
+    np.testing.assert_array_equal(analytic, yielded[0] + yielded[1])
+
+
+# ---------------------------------------------------------------------------
+# remaining satellites
+# ---------------------------------------------------------------------------
+
+
+def test_generate_use_cache_with_temperature_raises(tiny_config, tiny_params):
+    from tpukit.data import get_tokenizer
+    from tpukit.sampling import generate
+
+    tok = get_tokenizer()
+    with pytest.raises(ValueError, match="greedy-only"):
+        generate(
+            tiny_params, tiny_config, "The big brown cat ", tok,
+            use_cache=True, temperature=0.7,
+        )
+
+
+def test_generate_auto_cache_with_temperature_downgrades(
+    tiny_config, tiny_params, monkeypatch
+):
+    """Only an EXPLICIT use_cache=True raises: when the long-buffer
+    heuristic auto-resolves use_cache (caller passed None), sampling must
+    silently route to the re-forward loop as before (r5 #4 regression)."""
+    import tpukit.sampling as sampling
+    from tpukit.data import get_tokenizer
+
+    seen = {}
+
+    def fake_loop(params, cfg, buf, prompt_len, max_new, eos,
+                  temperature=0.0, top_k=0, rng=None):
+        seen["temperature"] = temperature
+        return buf, np.int32(int(prompt_len))
+
+    monkeypatch.setattr(sampling, "_decode_loop", fake_loop)
+    cfg = tiny_config.replace(max_position_embeddings=1024)
+    tok = get_tokenizer()
+    # buffer = prompt + 600 >= 512 tokens -> the heuristic would pick the
+    # cached loop; with temperature it must fall back, not raise
+    out = sampling.generate(
+        tiny_params, cfg, "The big brown cat ", tok,
+        max_new_tokens=600, temperature=0.7,
+    )
+    assert seen["temperature"] == 0.7
+    assert isinstance(out, str)
+
+
+def test_time_windows_zero_warmup():
+    from tools.bench_ladder import time_windows
+
+    def step(state, b, t):
+        return state, np.float32(1.5)
+
+    times, _, last = time_windows(step, None, None, None, steps=2, windows=1, warmup=0)
+    assert len(times) == 1 and last == 1.5
+
+
+def test_moe_config_fails_loudly_from_direct_value_and_grad(tiny_config):
+    """ADVICE r5 #1: the curated MoE ValueError (not a TypeError about
+    aux_out) from direct strategy.value_and_grad calls."""
+    from tpukit.mesh import create_mesh
+    from tpukit.pipeline import Pipeline, Pipeline1F1B
+    from tpukit.shardings import ContextParallel, TensorParallel
+
+    cfg = tiny_config.replace(num_experts=4)
+    dummy = {"input_ids": None}
+    for strat, match in [
+        (ContextParallel(create_mesh({"seq": 2})), "ExpertParallel"),
+        (TensorParallel(create_mesh({"model": 2})), "ExpertParallel"),
+        (Pipeline(create_mesh({"stage": 2})), "ExpertParallel"),
+        (Pipeline1F1B(create_mesh({"stage": 2})), "ExpertParallel"),
+    ]:
+        with pytest.raises(ValueError, match=match):
+            strat.value_and_grad({}, cfg, dummy, None)
+
+
+# ---------------------------------------------------------------------------
+# fit() end to end: the JSONL contract tools/report.py renders
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def telemetry_run(tmp_path_factory):
+    import os
+
+    from tpukit.flags import TrainFlags
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import fit
+
+    tmp = tmp_path_factory.mktemp("obs")
+    log = tmp / "run.jsonl"
+    hb = tmp / "hb"
+    flags = TrainFlags(
+        batch_size=8, epochs=1, sequence_length=33, dim=32, head_dim=8,
+        heads=4, num_layers=2, learning_rate=1e-3, dataset_slice="80",
+        num_workers=0, disable_amp=True, seed=0,
+        metrics_log=str(log), log_grad_norms=True, spike_threshold=8.0,
+        heartbeat_dir=str(hb),
+    )
+    cwd = os.getcwd()
+    os.chdir(tmp)  # checkpoints/ lands in tmp
+    try:
+        result = fit(flags, SingleDevice())
+    finally:
+        os.chdir(cwd)
+    records = [json.loads(line) for line in log.read_text().splitlines()]
+    return flags, result, records, log, hb
+
+
+def test_fit_emits_goodput_windows(telemetry_run):
+    _, _, records, _, _ = telemetry_run
+    train = [r for r in records if r["kind"] == "train"]
+    assert train, "no window record (dataset too small for PRINT_FREQ?)"
+    for r in train:
+        assert 0.0 < r["goodput"] <= 1.0
+        assert abs(sum(r["spans"].values()) - 1.0) < 1e-6
+        assert r["window_s"] > 0
+        for key in ("grad_norm", "update_norm", "param_norm"):
+            assert r[key] > 0.0
+        assert np.isfinite(r["loss"])
+
+
+def test_fit_emits_xla_analysis_once_per_compile(telemetry_run):
+    _, _, records, _, _ = telemetry_run
+    xla = [r for r in records if r["kind"] == "xla"]
+    fns = {r["fn"] for r in xla}
+    assert {"train_step", "eval_step"} <= fns
+    assert len(xla) == len(fns)  # once per compile, not per step/window
+    train_rec = next(r for r in xla if r["fn"] == "train_step")
+    assert train_rec["flops"] > 0
+    assert train_rec["bytes_accessed"] > 0
+    assert train_rec["memory"]["peak_bytes_estimate"] > 0
+    assert train_rec["strategy"] == "single"
+    assert train_rec["collectives"] == {}  # single device: no comm
+
+
+def test_fit_emits_epoch_and_validation_records(telemetry_run):
+    _, _, records, _, _ = telemetry_run
+    ep = next(r for r in records if r["kind"] == "epoch")
+    assert abs(sum(ep["fractions"].values()) - 1.0) < 1e-6
+    assert 0.0 < ep["goodput"] <= 1.0
+    assert ep["seconds"]["eval"] > 0 and ep["seconds"]["generate"] > 0
+    val = next(r for r in records if r["kind"] == "validation")
+    assert np.isfinite(val["loss"])
+
+
+def test_fit_writes_heartbeat_and_counts_no_spikes(telemetry_run):
+    _, result, _, _, hb = telemetry_run
+    files = list(hb.glob("heartbeat-p*.json"))
+    assert len(files) == 1  # one per process
+    beat = json.loads(files[0].read_text())
+    assert beat["process"] == 0
+    assert beat["step"] == int(result.state.step)
+    assert result.metrics["spike_events"] == 0
+
+
+def test_report_renders_run(telemetry_run):
+    from tools.report import load, summarize
+
+    _, _, _, log, _ = telemetry_run
+    text = summarize(load(str(log)))
+    assert "goodput" in text
+    assert "xla static analysis: train_step" in text
+    assert "val loss" in text
+
+
+def test_report_flags_unexpected_collectives():
+    """A strategy that DECLARES no collectives (comm_ops = ()) must have
+    every measured collective flagged; a foreign log without the key
+    cannot flag anything."""
+    from tools.report import summarize
+
+    base = {
+        "kind": "xla", "fn": "train_step", "strategy": "single",
+        "flops": 1.0, "bytes_accessed": 1.0, "memory": None, "time": 0,
+        "collectives": {"all-gather": {"count": 1, "bytes": 1024}},
+    }
+    declared_empty = summarize([dict(base, expected_comm_ops=[])])
+    assert "UNEXPECTED" in declared_empty
+    declared_match = summarize([dict(base, expected_comm_ops=["all-gather"])])
+    assert "UNEXPECTED" not in declared_match
+    undeclared = summarize([base])
+    assert "UNEXPECTED" not in undeclared
+
+
+# ---------------------------------------------------------------------------
+# multi-host heartbeats, for real (reuses the 2-process world harness)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_heartbeat_files_in_two_process_world(tmp_path):
+    from test_multiprocess import _launch_world
+
+    hb = tmp_path / "hb"
+    _launch_world(
+        "main-ddp.py", tmp_path,
+        extra=["--heartbeat_dir", str(hb), "--heartbeat_timeout", "300"],
+    )
+    files = sorted(p.name for p in hb.glob("heartbeat-p*.json"))
+    assert files == ["heartbeat-p00000.json", "heartbeat-p00001.json"]
+    recs = [json.loads((hb / f).read_text()) for f in files]
+    assert {r["process"] for r in recs} == {0, 1}
+    assert all(r["step"] > 0 for r in recs)  # the epoch-end beat

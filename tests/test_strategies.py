@@ -211,6 +211,23 @@ def test_fsdp_cpu_offload_degrades_on_cpu(cfg, batch):
     assert np.isfinite(float(loss))
 
 
+def _backend_knows_pinned_host() -> bool:
+    """Newer jax CPU backends expose a pinned_host memory space; older ones
+    reject the kind at NamedSharding validation, so the faked-support rule
+    test below cannot even construct its shardings there."""
+    try:
+        return any(
+            m.kind == "pinned_host" for m in jax.devices()[0].addressable_memories()
+        )
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(
+    not _backend_knows_pinned_host(),
+    reason="backend has no pinned_host memory space (jax < 0.5 CPU); the "
+    "real offload path runs in the TPU dryrun/bench",
+)
 def test_fsdp_offload_memory_kind_rule(cfg):
     """On TPU-like backends the offload shardings pin params to host memory;
     assert the rule by faking backend support (the real pinned_host path runs

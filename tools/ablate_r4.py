@@ -53,7 +53,7 @@ def time_step(cfg, batch_size, seq, strategy=None, steps=8, windows=3):
 
 def main():
     from tpukit.model import GPTConfig
-    from tpukit.profiling import peak_flops_per_chip, train_flops_per_token
+    from tpukit.obs import peak_flops_per_chip, train_flops_per_token
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", type=int, default=2048)

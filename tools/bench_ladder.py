@@ -61,9 +61,11 @@ def time_windows(step_fn, state, model_batch, targets, steps: int,
     as steady-state and may report the spread as the noise band. float()
     forces a real host sync — block_until_ready is insufficient on
     tunneled PJRT backends."""
+    last = None  # warmup=0 support (ADVICE r5 #5): no sync before the loops
     for _ in range(warmup):
         state, loss = step_fn(state, model_batch, targets)
-    last = float(loss)
+    if warmup:
+        last = float(loss)  # one sync: compile + warmup finish before timing
     times = []
     for _ in range(windows):
         t0 = time.perf_counter()
@@ -80,7 +82,7 @@ def bench_shape(name, dim, heads, head_dim, layers, seq, batch, remat, scan,
     import jax.numpy as jnp
 
     from tpukit.model import GPTConfig
-    from tpukit.profiling import peak_flops_per_chip, train_flops_per_token
+    from tpukit.obs import peak_flops_per_chip, train_flops_per_token
     from tpukit.shardings import SingleDevice
     from tpukit.train import create_train_state, make_optimizer, make_step_fns
 

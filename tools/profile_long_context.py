@@ -41,7 +41,7 @@ def _sync(out):
 
 def main():
     from tpukit.model import GPTConfig
-    from tpukit.profiling import peak_flops_per_chip
+    from tpukit.obs import peak_flops_per_chip
     from tpukit.shardings import SingleDevice
     from tpukit.train import create_train_state, make_optimizer, make_step_fns
 

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Render a tpukit metrics JSONL (`--metrics_log run.jsonl`) into a
+human-readable run summary.
+
+The trainer's StepLogger writes one JSON object per line, discriminated by
+`kind` (docs/DESIGN.md "Telemetry & observability"): "train" window records
+(loss, tokens/sec, MFU, goodput breakdown, HBM gauges, optional norms),
+"xla" once-per-compile static analysis (FLOPs, bytes, peak memory,
+per-collective comm bytes), "validation"/"epoch" per-epoch records, and
+"spike"/"straggler" sentinel events. This tool needs NOTHING but the file —
+no jax import, so it runs anywhere the log was copied to.
+
+Usage: python tools/report.py run.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def human_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.2f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.2f} TiB"
+
+
+def human_count(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f}{suffix}"
+    return f"{n:.0f}"
+
+
+def load(path: str) -> list[dict]:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # torn final line from a killed run
+    return records
+
+
+def _rows(records: list[dict], kind: str) -> list[dict]:
+    return [r for r in records if r.get("kind") == kind]
+
+
+def _fmt_fractions(frac: dict) -> str:
+    return " ".join(
+        f"{k}={v * 100:.0f}%"
+        for k, v in sorted(frac.items(), key=lambda kv: -kv[1])
+        if v >= 0.005
+    )
+
+
+def summarize(records: list[dict]) -> str:
+    out: list[str] = []
+    w = out.append
+
+    train = _rows(records, "train")
+    times = [r["time"] for r in records if "time" in r]
+    w("== run ==")
+    if times:
+        w(f"  duration: {max(times) - min(times):.1f}s "
+          f"({len(records)} records, {len(train)} train windows)")
+
+    if train:
+        last = train[-1]
+        losses = [r["loss"] for r in train if r.get("loss") is not None]
+        tps = [r["tokens_per_sec"] for r in train if r.get("tokens_per_sec")]
+        mfu = [r["mfu"] for r in train if r.get("mfu")]
+        w("== training ==")
+        w(f"  steps: {last.get('step', '-')}   "
+          f"loss first->last: {losses[0]:.4f} -> {losses[-1]:.4f}   "
+          f"min: {min(losses):.4f}")
+        if tps:
+            w(f"  tokens/sec last: {human_count(tps[-1])}   best: {human_count(max(tps))}"
+              + (f"   MFU last: {mfu[-1] * 100:.1f}%   best: {max(mfu) * 100:.1f}%"
+                 if mfu else ""))
+        goodput = [r["goodput"] for r in train if r.get("goodput") is not None]
+        if goodput:
+            mean_gp = sum(goodput) / len(goodput)
+            w(f"  goodput (time in compiled step): mean {mean_gp * 100:.1f}%  "
+              f"min {min(goodput) * 100:.1f}%")
+            span_keys: dict[str, list[float]] = {}
+            for r in train:
+                for k, v in (r.get("spans") or {}).items():
+                    span_keys.setdefault(k, []).append(v)
+            w("  span split (mean): "
+              + _fmt_fractions({k: sum(v) / len(v) for k, v in span_keys.items()}))
+        hbm_peaks = [
+            (r.get("hbm") or {}).get("peak_bytes_in_use")
+            or (r.get("hbm") or {}).get("bytes_in_use")
+            for r in train
+        ]
+        hbm_peaks = [p for p in hbm_peaks if p]
+        if hbm_peaks:
+            limit = next(
+                ((r.get("hbm") or {}).get("bytes_limit") for r in train
+                 if (r.get("hbm") or {}).get("bytes_limit")), None)
+            w(f"  peak HBM in use: {human_bytes(max(hbm_peaks))}"
+              + (f" of {human_bytes(limit)}" if limit else ""))
+        norms = [r for r in train if "grad_norm" in r]
+        if norms:
+            gn = [r["grad_norm"] for r in norms]
+            w(f"  grad norm last: {gn[-1]:.4g}   max: {max(gn):.4g}   "
+              f"param norm last: {norms[-1].get('param_norm', float('nan')):.4g}")
+
+    for r in _rows(records, "xla"):
+        w(f"== xla static analysis: {r.get('fn', '?')} "
+          f"[{r.get('strategy', '?')}] ==")
+        w(f"  flops/step: {human_count(r.get('flops'))}   "
+          f"bytes accessed/step: {human_bytes(r.get('bytes_accessed'))}")
+        mem = r.get("memory") or {}
+        if mem:
+            w(f"  memory: args {human_bytes(mem.get('argument_size_in_bytes'))}  "
+              f"temp {human_bytes(mem.get('temp_size_in_bytes'))}  "
+              f"peak est {human_bytes(mem.get('peak_bytes_estimate'))}")
+        coll = r.get("collectives") or {}
+        # Declared-empty (comm_ops = (), e.g. single device: EVERY collective
+        # is a surprise) is distinct from undeclared (key absent in a foreign
+        # log: nothing can be flagged).
+        raw_expected = r.get("expected_comm_ops")
+        expected = None if raw_expected is None else set(raw_expected)
+        if coll:
+            w("  comm bytes/step (from compiled HLO):")
+            for op, rec in sorted(coll.items(), key=lambda kv: -kv[1]["bytes"]):
+                flag = (
+                    "  <- UNEXPECTED"
+                    if expected is not None and op not in expected
+                    else ""
+                )
+                w(f"    {op:<20} x{rec['count']:<4} {human_bytes(rec['bytes'])}{flag}")
+        elif expected:
+            w(f"  comm: none found (strategy expected {sorted(expected)})")
+
+    val = _rows(records, "validation")
+    epochs = _rows(records, "epoch")
+    if val or epochs:
+        w("== epochs ==")
+    for r in val:
+        w(f"  epoch {r.get('epoch', '?')}: val loss {r.get('loss', float('nan')):.4f}  "
+          f"accuracy {r.get('accuracy', float('nan')):.2f}%")
+    for r in epochs:
+        w(f"  epoch {r.get('epoch', '?')} wallclock {r.get('total_s', 0):.1f}s  "
+          f"goodput {r.get('goodput', 0) * 100:.1f}%  "
+          f"[{_fmt_fractions(r.get('fractions') or {})}]")
+
+    spikes = _rows(records, "spike")
+    if spikes:
+        w("== sentinel events ==")
+        for r in spikes:
+            w(f"  {r.get('event', '?'):<6} step {r.get('step', '?'):<8} "
+              f"loss {r.get('loss')}"
+              + (f"  (mean {r['mean']:.4f} std {r['std']:.4f})"
+                 if r.get("mean") is not None else "")
+              + f"  action={r.get('action', '?')}")
+    stragglers = _rows(records, "straggler")
+    if stragglers:
+        w("== stragglers ==")
+        for r in stragglers:
+            w(f"  step {r.get('step', '?')}: {r.get('stragglers')}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("log", help="metrics JSONL written via --metrics_log")
+    args = ap.parse_args(argv)
+    records = load(args.log)
+    if not records:
+        print(f"{args.log}: no records", file=sys.stderr)
+        return 1
+    print(summarize(records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

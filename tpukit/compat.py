@@ -1,0 +1,57 @@
+"""JAX version-compat shims.
+
+tpukit tracks current JAX API spellings; deployment images sometimes pin an
+older jax (no new deps may be installed there — the repo must gate, not
+require). Two surfaces moved between jax 0.4.x and newer releases:
+
+  - `shard_map`: newer jax exports it as `jax.shard_map` and spells the
+    replication-check kwarg `check_vma`; 0.4.x has it under
+    `jax.experimental.shard_map` with the kwarg named `check_rep`.
+  - `custom_partitioning.def_partition`: newer jax accepts a
+    `sharding_rule` einsum-style hint (for the Shardy partitioner) next to
+    `partition`/`infer_sharding_from_operands`; 0.4.x rejects the kwarg.
+    Every tpukit kernel supplies the real partition/infer callbacks, so on
+    old jax the hint is simply dropped.
+
+Import `shard_map` and `def_partition` from here instead of jax directly.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:  # newer jax
+    from jax import shard_map as _shard_map
+except ImportError:  # jax < 0.5
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_HAS_CHECK_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+@functools.wraps(_shard_map)
+def shard_map(*args, **kwargs):
+    if not _HAS_CHECK_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(*args, **kwargs)
+
+
+def def_partition(cp, **kwargs):
+    """`cp.def_partition(**kwargs)`, dropping the `sharding_rule` hint on
+    jax versions whose signature predates it."""
+    try:
+        return cp.def_partition(**kwargs)
+    except TypeError:
+        kwargs.pop("sharding_rule", None)
+        return cp.def_partition(**kwargs)
+
+
+def axis_size(axis_name) -> "int | object":
+    """`jax.lax.axis_size` (newer jax) with the classic psum-of-ones
+    fallback for versions that predate it. Only valid inside shard_map/pmap
+    contexts, like the original."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)

@@ -54,6 +54,21 @@ class TrainFlags:
     # Debug toolchain (SURVEY §5 race-detection plan): aborts with a traceback
     # at the first NaN/Inf produced inside any jitted computation.
     debug_nans: bool = False
+    # Telemetry (tpukit/obs, round 6). --log_grad_norms computes global
+    # grad/update/param L2 norms INSIDE the existing jitted train step and
+    # logs them per window; off = the compiled step is untouched.
+    log_grad_norms: bool = False
+    # Loss-spike/NaN sentinel on the window-averaged loss: 0 disables; N > 0
+    # fires when the loss exceeds the rolling mean by N deviations (or goes
+    # non-finite). Action: "warn" logs and continues; "abort" writes a
+    # checkpoint then raises (so the blow-up step is preserved for autopsy).
+    spike_threshold: float = 0.0
+    spike_action: str = "warn"  # warn | abort
+    # Multi-host liveness: if set, every process writes a heartbeat file
+    # (step + timestamp) to this SHARED directory each PRINT_FREQ window and
+    # process 0 reports processes whose beats go stale past the timeout.
+    heartbeat_dir: str = ""
+    heartbeat_timeout: float = 120.0  # seconds
     # Rematerialization policy: checkpoint each decoder layer (backward
     # recomputes the layer forward; less HBM traffic and memory — needed for
     # the larger ladder configs at long sequence).
@@ -134,6 +149,17 @@ def build_parser(
     parser.add_argument("--profile_dir", type=str, default=defaults.profile_dir)
     parser.add_argument("--metrics_log", type=str, default=defaults.metrics_log)
     parser.add_argument("--debug_nans", action="store_true")
+    parser.add_argument("--log_grad_norms", action="store_true")
+    parser.add_argument(
+        "--spike_threshold", type=float, default=defaults.spike_threshold
+    )
+    parser.add_argument(
+        "--spike_action", choices=("warn", "abort"), default=defaults.spike_action
+    )
+    parser.add_argument("--heartbeat_dir", type=str, default=defaults.heartbeat_dir)
+    parser.add_argument(
+        "--heartbeat_timeout", type=float, default=defaults.heartbeat_timeout
+    )
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--scan_layers", action="store_true")
     parser.add_argument("--microbatches", type=int, default=defaults.microbatches)

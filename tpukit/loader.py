@@ -179,32 +179,78 @@ class DataLoader:
         """Per-batch ORIGINAL-row counts summed over all replicas.
 
         The wrap/sentinel pad positions depend only on (dataset_len,
-        num_replicas, batch_size) — never on the shuffle values — so every
-        rank can compute the global schedule with pure host math. This is
-        what makes the throughput meter exact on ragged final batches
-        (VERDICT r4 #6) WITHOUT a per-step cross-host reduction (which
-        would re-serialize the async-dispatch pipeline it is timing)."""
+        num_replicas, batch_size) — never on the shuffle values — so the
+        whole global schedule is closed-form host math (ADVICE r5 #3: the
+        previous implementation re-materialized every rank's shuffled
+        permutation, O(num_replicas x dataset) work per epoch fleet-wide).
+        This is what makes the throughput meter exact on ragged final
+        batches (VERDICT r4 #6) WITHOUT a per-step cross-host reduction
+        (which would re-serialize the async dispatch it is timing).
+
+        Derivation: shuffling permutes index VALUES, never pad POSITIONS.
+        Rank r's entry j sits at base position `r + j*R` (R replicas,
+        rank-strided split), which is an original sample iff `r + j*R < N`
+        — wrap duplicates and -1 sentinels both occupy positions >= N.
+        Summed over ranks, row j therefore carries `clip(N - j*R, 0, R)`
+        real rows; rows in the per-rank batch-padding tail carry none.
+
+        Subclass safety (ADVICE r5 #3): the closed form mirrors the BASE
+        `_indices` schedule, so when `type(self)` overrides `_indices` this
+        falls back to enumerating the subclass's own schedule per rank
+        (sweeping `self.rank` through its actual `_indices`) instead of
+        silently answering with the base math.
+        """
+        if type(self)._indices is not DataLoader._indices:
+            return self._enumerated_real_row_counts()
+        n = len(self.dataset)
+        reps = self.num_replicas
+        if self.drop_last and n % reps:
+            samples = n // reps  # even-split truncation
+        else:
+            samples = math.ceil(n / reps)  # pad-by-wrapping / -1 sentinels
+        per_rank = samples
+        if self.pad_to_batch and per_rank % self.batch_size:
+            per_rank += self.batch_size - per_rank % self.batch_size
+        stop = (
+            (per_rank // self.batch_size) * self.batch_size
+            if self.drop_last
+            else per_rank
+        )
+        if stop == 0:
+            return np.zeros(0, dtype=np.int64)
+        j = np.arange(stop, dtype=np.int64)
+        real = np.clip(n - j * reps, 0, reps)
+        real[j >= samples] = 0  # per-rank batch padding (wrap or sentinel)
+        return np.add.reduceat(real, np.arange(0, stop, self.batch_size))
+
+    def _enumerated_real_row_counts(self) -> np.ndarray:
+        """Generic fallback for subclasses with a custom `_indices`: sweep
+        `self.rank` through every replica and sum each rank's actual real
+        mask per batch. O(num_replicas x dataset) host work — the price of
+        an arbitrary schedule; the base class uses the closed form above."""
+        prev_rank = self.rank
         totals = None
-        for rank in range(self.num_replicas):
-            clone = DataLoader(
-                self.dataset, self.batch_size, shuffle=self.shuffle,
-                seed=self.seed, num_replicas=self.num_replicas, rank=rank,
-                drop_last=self.drop_last, pad_to_batch=self.pad_to_batch,
-                pad_mode=self.pad_mode, pad_fill=self.pad_fill,
-            )
-            clone.set_epoch(self.epoch)
-            _, real = clone._indices()
-            n = len(real)
-            stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
-            per_batch = np.array(
-                [
-                    int(real[s : s + self.batch_size].sum())
-                    for s in range(0, stop, self.batch_size)
-                ],
-                dtype=np.int64,
-            )
-            totals = per_batch if totals is None else totals + per_batch
-        return totals
+        try:
+            for rank in range(self.num_replicas):
+                self.rank = rank
+                _, real = self._indices()
+                n = len(real)
+                stop = (
+                    (n // self.batch_size) * self.batch_size
+                    if self.drop_last
+                    else n
+                )
+                per_batch = np.array(
+                    [
+                        int(real[s : s + self.batch_size].sum())
+                        for s in range(0, stop, self.batch_size)
+                    ],
+                    dtype=np.int64,
+                )
+                totals = per_batch if totals is None else totals + per_batch
+        finally:
+            self.rank = prev_rank
+        return totals if totals is not None else np.zeros(0, dtype=np.int64)
 
     def __iter__(self) -> Iterator[dict]:
         indices, real = self._indices()

@@ -348,7 +348,15 @@ def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic):
     )
 
     # Switch load-balance terms; /top_k keeps frac_tokens a distribution
-    # (each token contributes k assignments)
+    # (each token contributes k assignments).
+    # Documented deviation from Switch (ADVICE r5 #2): these statistics
+    # average over EVERY sequence position, including pad positions (the
+    # paper computes them over real tokens only), so heavily padded batches
+    # dilute the balance signal toward how pads route. Gradient flow to
+    # real-token CE is unaffected — the aux loss is a regularizer — and the
+    # fixture/TinyStories batches are near-full, so the skew is accepted
+    # for the same reason as the other twin quirks in this file. Masking
+    # would need the pad mask threaded into every FFN call site.
     frac_tokens = jnp.mean(assign, axis=1) / top_k  # [B, E]
     mean_prob = jnp.mean(probs, axis=1)  # [B, E]
     aux = n_exp * jnp.mean(jnp.sum(frac_tokens * mean_prob, axis=-1))

@@ -1,0 +1,39 @@
+"""tpukit.obs — the telemetry subsystem.
+
+Supersedes the old flat `tpukit/profiling.py` (now a compat shim). Four
+pillars, one per module:
+
+  - `meter`     — MFUMeter (tokens/sec, MFU), `trace`, JSONL `StepLogger`.
+  - `spans`     — `SpanTimeline`: host-phase wall-clock accounting and the
+                  goodput breakdown (fraction of time inside the compiled
+                  step vs data wait / H2D / checkpoint / eval).
+  - `xla`       — static analysis of compiled steps: `cost_analysis` FLOPs
+                  and bytes, `memory_analysis` peak HBM, per-collective
+                  comm bytes parsed from the optimized HLO, plus live
+                  `device.memory_stats()` gauges.
+  - `sentinels` — in-jit global grad/update/param norms and the host-side
+                  loss-spike/NaN `SpikeSentinel`.
+  - `heartbeat` — per-process liveness files + process-0 straggler check
+                  for multi-host runs.
+
+The trainer (`tpukit/train.py`) wires all four through `fit()`;
+`tools/report.py` renders a run's JSONL into a human-readable summary.
+"""
+
+from tpukit.obs.heartbeat import Heartbeat  # noqa: F401
+from tpukit.obs.meter import (  # noqa: F401
+    MFUMeter,
+    StepLogger,
+    matmul_param_count,
+    peak_flops_per_chip,
+    trace,
+    train_flops_per_token,
+)
+from tpukit.obs.sentinels import SpikeEvent, SpikeSentinel, global_norms  # noqa: F401
+from tpukit.obs.spans import GOODPUT_SPANS, SpanTimeline, format_breakdown  # noqa: F401
+from tpukit.obs.xla import (  # noqa: F401
+    COLLECTIVE_OPS,
+    collective_bytes,
+    compiled_stats,
+    live_memory_stats,
+)

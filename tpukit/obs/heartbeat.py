@@ -1,0 +1,129 @@
+"""Multi-host liveness: per-process heartbeat files + straggler check.
+
+The classic multi-host failure mode is the silent hang: one process stalls
+inside a collective (bad host, wedged data loader, the reference's
+rank-0-only generate — SURVEY §3.5) and every OTHER process blocks with it,
+so nothing is printed anywhere and the job just stops. Heartbeat files turn
+that into a diagnosable state: every process writes
+`heartbeat-p{index:05d}.json` (step, wall time) to a SHARED directory each
+step window, and process 0 reads them back and names the processes whose
+beats are stale or whose step lags the fleet.
+
+The check is advisory (it prints/logs; it does not kill anything): when the
+hang is inside a collective, process 0 is usually blocked in it too — the
+value is the on-disk breadcrumb an operator (or a babysitter script tailing
+the directory) reads to see WHICH host stopped advancing and at what step,
+instead of staring at N identical frozen consoles.
+
+Writes are atomic (tmp + rename) so a reader never sees a torn JSON file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+
+def _beat_path(directory: Path, process_index: int) -> Path:
+    return directory / f"heartbeat-p{process_index:05d}.json"
+
+
+class Heartbeat:
+    """One process's beat writer + (on any process) the fleet reader.
+
+    `directory` must be shared across hosts (NFS/GCS-fuse) for the
+    cross-host check to see every file; per-host local dirs still give
+    per-host liveness breadcrumbs.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        process_index: int | None = None,
+        process_count: int | None = None,
+        timeout_s: float = 120.0,
+    ):
+        import jax
+
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.process_index = (
+            jax.process_index() if process_index is None else process_index
+        )
+        self.process_count = (
+            jax.process_count() if process_count is None else process_count
+        )
+        self.timeout_s = timeout_s
+        self.path = _beat_path(self.directory, self.process_index)
+        self._last_beat: float | None = None
+        self._cadence: float | None = None  # observed seconds between beats
+
+    def beat(self, step: int, now: float | None = None) -> None:
+        """Write this process's liveness record (atomic replace)."""
+        now = time.time() if now is None else now
+        if self._last_beat is not None:
+            self._cadence = now - self._last_beat
+        self._last_beat = now
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(
+            json.dumps(
+                {
+                    "process": self.process_index,
+                    "step": int(step),
+                    "time": now,
+                }
+            )
+        )
+        os.replace(tmp, self.path)
+
+    def read_all(self) -> dict[int, dict]:
+        """All readable beat records in the directory, keyed by process."""
+        out: dict[int, dict] = {}
+        for path in sorted(self.directory.glob("heartbeat-p*.json")):
+            try:
+                rec = json.loads(path.read_text())
+                out[int(rec["process"])] = rec
+            except (ValueError, KeyError, OSError):
+                continue  # torn/foreign file: skip, never raise
+        return out
+
+    def check(self, now: float | None = None, step_lag: int = 0) -> list[dict]:
+        """Straggler report (run on process 0 each window). A process
+        straggles when its beat file is missing, its beat is older than the
+        effective timeout, or (`step_lag` > 0) its step trails the fleet max
+        by more than `step_lag`. Returns one record per straggler:
+        `{process, reason, age_s?, step?, behind?}`.
+
+        The effective timeout is `max(timeout_s, 3x this process's own
+        observed beat cadence)`: beats land once per PRINT_FREQ window, so
+        a big-model run whose window exceeds a fixed timeout would
+        otherwise flag every healthy peer on every check — the caller's
+        cadence is the only window-duration estimate available in advance.
+        """
+        now = time.time() if now is None else now
+        effective = self.timeout_s
+        if self._cadence:
+            effective = max(effective, 3.0 * self._cadence)
+        beats = self.read_all()
+        max_step = max((r.get("step", 0) for r in beats.values()), default=0)
+        out = []
+        for proc in range(self.process_count):
+            rec = beats.get(proc)
+            if rec is None:
+                out.append({"process": proc, "reason": "missing"})
+                continue
+            age = now - rec.get("time", 0.0)
+            if age > effective:
+                out.append(
+                    {"process": proc, "reason": "stale",
+                     "age_s": round(age, 1), "step": rec.get("step")}
+                )
+            elif step_lag and max_step - rec.get("step", 0) > step_lag:
+                out.append(
+                    {"process": proc, "reason": "lagging",
+                     "step": rec.get("step"),
+                     "behind": max_step - rec.get("step", 0)}
+                )
+        return out

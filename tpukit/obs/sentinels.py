@@ -1,0 +1,105 @@
+"""Training-health sentinels: global norms in-jit, loss spikes on host.
+
+Two complementary guards (SURVEY §5; the reference trains blind beyond its
+tqdm bar):
+
+  - `global_norms(grads, updates, params)`: the traced half. Called INSIDE
+    the already-jitted train step when `--log_grad_norms` is on, so the
+    norms ride the existing compile — no second program, no extra D2H sync
+    until the window boundary. With the flag off the train step's traced
+    graph is untouched (the call never happens), keeping the compiled HLO
+    byte-identical to a telemetry-free build.
+  - `SpikeSentinel`: the host half. Watches the window-averaged loss the
+    trainer already syncs once per PRINT_FREQ window; fires on NaN/Inf
+    immediately and on a loss exceeding the running mean by
+    `threshold * max(std, floor)` once enough history exists. The action is
+    the caller's ("warn" logs and continues; "abort" checkpoints then
+    raises) — complementing `--debug_nans`, which catches NaN at the op
+    level inside jit but cannot see a finite-but-diverging loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import deque
+
+import jax.numpy as jnp
+import optax
+
+
+def global_norms(grads, updates=None, params=None) -> dict:
+    """Global L2 norms as a dict of f32 scalars — call inside the jitted
+    train step. optax.global_norm flattens the pytree; under GSPMD the
+    reduction follows the leaves' shardings, so sharded (FSDP/TP/EP) state
+    yields the exact global norm with the partial-reduce collectives the
+    compiler picks."""
+    out = {"grad_norm": optax.global_norm(grads).astype(jnp.float32)}
+    if updates is not None:
+        out["update_norm"] = optax.global_norm(updates).astype(jnp.float32)
+    if params is not None:
+        out["param_norm"] = optax.global_norm(params).astype(jnp.float32)
+    return out
+
+
+@dataclasses.dataclass
+class SpikeEvent:
+    kind: str  # "nan" | "spike"
+    step: int
+    loss: float
+    mean: float | None = None
+    std: float | None = None
+
+    def record(self) -> dict:
+        """JSONL-ready dict (kind field renamed to avoid the logger's own
+        record discriminator; non-finite losses stringified — bare NaN is
+        not valid JSON for downstream strict parsers)."""
+        loss = self.loss if math.isfinite(self.loss) else str(self.loss)
+        return {
+            "event": self.kind, "step": self.step, "loss": loss,
+            "mean": self.mean, "std": self.std,
+        }
+
+
+class SpikeSentinel:
+    """Rolling-window loss-spike and NaN detector.
+
+    `observe(loss, step)` returns a `SpikeEvent` when the sentinel fires,
+    else None. Detection: non-finite losses fire always; finite losses fire
+    when `loss > mean + threshold * max(std, rel_floor * |mean|)` over the
+    last `window` observed losses, once `min_history` of them exist. The
+    std floor keeps a flat early loss curve (std ~ 0) from flagging normal
+    noise. Spiking values are NOT added to the history, so the baseline
+    tracks healthy training and a sustained divergence keeps firing.
+    """
+
+    def __init__(
+        self,
+        threshold: float,
+        window: int = 32,
+        min_history: int = 4,
+        rel_floor: float = 0.05,
+    ):
+        if threshold <= 0:
+            raise ValueError(f"spike threshold must be > 0, got {threshold}")
+        self.threshold = threshold
+        self.min_history = min_history
+        self.rel_floor = rel_floor
+        self._hist: deque[float] = deque(maxlen=window)
+
+    def observe(self, loss: float, step: int) -> SpikeEvent | None:
+        loss = float(loss)
+        if not math.isfinite(loss):
+            return SpikeEvent(kind="nan", step=step, loss=loss)
+        if len(self._hist) >= self.min_history:
+            n = len(self._hist)
+            mean = sum(self._hist) / n
+            var = sum((x - mean) ** 2 for x in self._hist) / n
+            band = max(math.sqrt(var), self.rel_floor * abs(mean), 1e-12)
+            if loss > mean + self.threshold * band:
+                return SpikeEvent(
+                    kind="spike", step=step, loss=loss,
+                    mean=mean, std=math.sqrt(var),
+                )
+        self._hist.append(loss)
+        return None

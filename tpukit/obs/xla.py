@@ -1,0 +1,200 @@
+"""XLA static analysis of compiled step functions + live device memory.
+
+Every run should know its roofline position and memory watermark without a
+profiler attached (SURVEY §5; EQuARX in PAPERS.md shows collective volume
+is a first-order cost worth metering). Three captures:
+
+  - `compiled_stats(jitted_fn, *avals)`: AOT `lower().compile()` at the
+    given avals and pull XLA's `cost_analysis()` (FLOPs, bytes accessed)
+    and `memory_analysis()` (argument/output/temp/peak bytes). jit and the
+    AOT path share the lowering/compilation caches, so when the trainer has
+    already compiled the step this records the SAME executable rather than
+    forcing a second compile.
+  - `collective_bytes(hlo_text)`: per-collective-kind op counts and payload
+    bytes parsed from the optimized HLO — the DP grad psum, FSDP
+    all-gather/reduce-scatter, pipeline/ring ppermute, and MoE all_to_all
+    traffic REPORTED from the compiled module instead of estimated from
+    first principles. Each strategy declares which kinds it expects
+    (`Strategy.comm_ops`), so a report can flag surprises.
+  - `live_memory_stats()`: `device.memory_stats()` gauges (bytes in use,
+    peak, limit) for the per-window HBM watermark line. Returns None on
+    backends without the API (CPU).
+
+Everything here is best-effort: any backend that lacks an analysis returns
+None for that field rather than raising — telemetry must never take down a
+training run.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+
+# HLO collective ops worth metering, normalized (async "-start" variants
+# fold into the base name; "-done" carries no payload and is skipped).
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+_ITEMSIZE = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# `f32[8,256]{1,0}` or scalar `f32[]` — group 1 dtype, group 2 dims.
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+# `%x = SHAPES op-name(` where SHAPES is a single shape or a (tuple).
+_OP_RE = re.compile(
+    r"=\s+((?:\([^)]*\))|(?:\S+))\s+("
+    + "|".join(COLLECTIVE_OPS)
+    + r")(-start)?\("
+)
+
+
+def _shape_list(shape_str: str) -> list[tuple[str, int]]:
+    """[(dtype, bytes)] for every array shape in a shape/tuple string."""
+    out = []
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        size = _ITEMSIZE.get(dtype)
+        if size is None:
+            continue  # token/opaque types carry no payload
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append((dtype, n * size))
+    return out
+
+
+# Async `-start` ops whose result tuple ALIASES the operands alongside the
+# results: `(operands..., results..., ctx scalars...)`. all-reduce-start's
+# tuple (when present) holds only the reduced results — XLA's combiner
+# fuses grad buffers into one variadic all-reduce — so halving it would
+# drop real payload.
+_START_WITH_OPERAND_ALIASES = ("all-gather", "collective-permute")
+
+
+def _result_bytes(shape_str: str, op: str, is_start: bool) -> int:
+    """Result payload of one collective instance. Sync ops: the full result
+    shape (a tuple IS the result for multi-operand all-reduce). For async
+    `-start` forms of the operand-aliasing ops above, count only the
+    results half, else the aliases double the reported volume on exactly
+    the backends (TPU) that emit async pairs."""
+    shapes = _shape_list(shape_str)
+    if is_start and op in _START_WITH_OPERAND_ALIASES:
+        # drop the u32/s32 context scalars these async ops append
+        shapes = [
+            (dt, b) for dt, b in shapes
+            if not (b <= 8 and dt in ("u32", "s32", "u64", "s64"))
+        ]
+        if len(shapes) >= 2 and len(shapes) % 2 == 0:
+            shapes = shapes[len(shapes) // 2:]
+    return sum(b for _, b in shapes)
+
+
+def collective_bytes(hlo_text: str) -> dict[str, dict[str, int]]:
+    """Parse optimized HLO text into {op: {count, bytes}} for the
+    collective kinds above. `bytes` is the summed RESULT payload of each op
+    instance — the volume moved per executed step (an all-reduce's result
+    equals its input size; an all-gather's result is the post-gather
+    size). Async `-start`/`-done` pairs count once, by their result."""
+    out: dict[str, dict[str, int]] = {}
+    for m in _OP_RE.finditer(hlo_text):
+        shape_str, op, start = m.group(1), m.group(2), m.group(3)
+        rec = out.setdefault(op, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += _result_bytes(shape_str, op, is_start=start is not None)
+    return out
+
+
+def _cost_analysis_dict(compiled) -> dict | None:
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    # jax returned a list-of-dicts (one per computation) before ~0.5, a
+    # plain dict after
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return dict(ca) if ca else None
+
+
+def _memory_analysis_dict(compiled) -> dict | None:
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    fields = (
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "temp_size_in_bytes",
+        "alias_size_in_bytes",
+        "generated_code_size_in_bytes",
+    )
+    out = {f: int(getattr(ma, f)) for f in fields if hasattr(ma, f)}
+    if "argument_size_in_bytes" in out and "temp_size_in_bytes" in out:
+        # arguments alias in place (donated state), so live peak is
+        # args + outputs-not-aliased + temps; report the conservative sum
+        out["peak_bytes_estimate"] = (
+            out["argument_size_in_bytes"]
+            + out.get("output_size_in_bytes", 0)
+            - out.get("alias_size_in_bytes", 0)
+            + out["temp_size_in_bytes"]
+        )
+    return out or None
+
+
+def compiled_stats(jitted_fn, *args, **kwargs) -> dict | None:
+    """Static analysis record for `jitted_fn` at the given avals (pass
+    `jax.ShapeDtypeStruct`s or arrays). Returns None when lowering fails;
+    individual analyses a backend lacks come back as None fields.
+
+    Record fields: `flops`, `bytes_accessed`, `transcendentals` (per
+    executed step, from cost_analysis), `memory` (memory_analysis sizes),
+    `collectives` ({op: {count, bytes}} from the optimized HLO).
+    """
+    try:
+        compiled = jitted_fn.lower(*args, **kwargs).compile()
+    except Exception:
+        return None
+    out: dict = {"flops": None, "bytes_accessed": None, "memory": None,
+                 "collectives": None}
+    ca = _cost_analysis_dict(compiled)
+    if ca:
+        out["flops"] = ca.get("flops")
+        out["bytes_accessed"] = ca.get("bytes accessed")
+        if ca.get("transcendentals"):
+            out["transcendentals"] = ca.get("transcendentals")
+    out["memory"] = _memory_analysis_dict(compiled)
+    try:
+        out["collectives"] = collective_bytes(compiled.as_text())
+    except Exception:
+        pass
+    return out
+
+
+def live_memory_stats(device=None) -> dict | None:
+    """Current device memory gauges, or None where the backend has no
+    `memory_stats()` (CPU). Keys mirror PJRT's: bytes_in_use,
+    peak_bytes_in_use, bytes_limit (whichever the platform reports)."""
+    d = device if device is not None else jax.devices()[0]
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+            "largest_alloc_size")
+    out = {k: int(stats[k]) for k in keep if k in stats}
+    return out or None

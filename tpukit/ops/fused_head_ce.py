@@ -35,6 +35,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.custom_partitioning import custom_partitioning
 from jax.experimental.pallas import tpu as pltpu
 
+from tpukit.compat import def_partition as compat_def_partition
 from tpukit.ops.layers import IGNORE_INDEX  # one sentinel for every loss path
 from tpukit.ops.pallas_attention import _interpret, tpu_compiler_params
 
@@ -331,7 +332,7 @@ def _fwd_infer(vocab_size, with_argmax, mesh, arg_infos, result_infos):
 
 
 _fwd_cp = custom_partitioning(_fused_fwd_arrays, static_argnums=(3, 4))
-_fwd_cp.def_partition(
+compat_def_partition(_fwd_cp, 
     partition=_fwd_partition,
     infer_sharding_from_operands=_fwd_infer,
     sharding_rule="n d, d v, n -> n, n, n",
@@ -361,7 +362,7 @@ def _bwd_infer(vocab_size, mesh, arg_infos, result_infos):
 
 
 _bwd_cp = custom_partitioning(_fused_bwd_arrays, static_argnums=(6,))
-_bwd_cp.def_partition(
+compat_def_partition(_bwd_cp, 
     partition=_bwd_partition,
     infer_sharding_from_operands=_bwd_infer,
     sharding_rule="n d, d v, n, n, n, n -> n d, d v",

@@ -50,6 +50,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.custom_partitioning import custom_partitioning
 from jax.experimental.pallas import tpu as pltpu
 
+from tpukit.compat import def_partition as compat_def_partition
+
 NEG_INF = -1e9  # causal additive term (twin of models/gpt.py:83)
 
 _LANES = 128
@@ -682,7 +684,7 @@ def _make_partition(impl, n_out):
 
 _fwd4 = custom_partitioning(_fwd4_impl, static_argnums=(4, 5, 6))
 _fwd4_partition, _fwd4_infer = _make_partition(_fwd4_impl, 2)
-_fwd4.def_partition(
+compat_def_partition(_fwd4, 
     partition=_fwd4_partition,
     infer_sharding_from_operands=_fwd4_infer,
     # b (batch) and h (heads) are shardable; s/d must stay whole per device
@@ -691,7 +693,7 @@ _fwd4.def_partition(
 
 _bwd4 = custom_partitioning(_bwd4_impl, static_argnums=(7, 8, 9))
 _bwd4_partition, _bwd4_infer = _make_partition(_bwd4_impl, 3)
-_bwd4.def_partition(
+compat_def_partition(_bwd4, 
     partition=_bwd4_partition,
     infer_sharding_from_operands=_bwd4_infer,
     sharding_rule=(

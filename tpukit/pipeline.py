@@ -61,7 +61,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from tpukit.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpukit import mesh as mesh_lib
@@ -107,6 +107,9 @@ class Pipeline(Strategy):
     `("data", "stage")` for the DDP hybrid."""
 
     name = "pipe"
+    # activation/cotangent hops between stages; the final loss/grad psums
+    # (GSPMD may also emit all-reduce for the data-hybrid grad sum)
+    comm_ops = ("collective-permute", "all-reduce")
 
     def __init__(
         self, mesh: Mesh | None = None, num_microbatches: int | str | None = None
@@ -147,15 +150,22 @@ class Pipeline(Strategy):
         """Stacked-layer count after padding to a stage multiple."""
         return -(-num_layers // self.num_stages) * self.num_stages
 
-    def validate_config(self, cfg: gpt.GPTConfig) -> None:
-        if cfg.num_layers < 1:
-            raise ValueError(f"num_layers must be >= 1, got {cfg.num_layers}")
+    @staticmethod
+    def _reject_moe(cfg: gpt.GPTConfig) -> None:
+        """The curated MoE rejection — raised from validate_config (the
+        fit() entry point) AND from loss_fn/value_and_grad, so direct
+        strategy calls fail just as loudly (ADVICE r5 #1)."""
         if cfg.num_experts > 0:
             raise ValueError(
                 "the pipeline schedules do not support MoE configs (the "
                 "micro-batched loss paths have no aux-loss channel) — use "
                 "ExpertParallel (main-moe.py), optionally with a data axis"
             )
+
+    def validate_config(self, cfg: gpt.GPTConfig) -> None:
+        if cfg.num_layers < 1:
+            raise ValueError(f"num_layers must be >= 1, got {cfg.num_layers}")
+        self._reject_moe(cfg)
 
     def _vocab_spec(self, names: tuple, shape: tuple) -> P | None:
         """Single source of truth for vocab-over-stage placement. Both
@@ -233,8 +243,12 @@ class Pipeline(Strategy):
 
     def loss_fn(
         self, params, cfg: gpt.GPTConfig, batch, targets,
-        with_accuracy: bool = False, rng=None,
+        with_accuracy: bool = False, rng=None, aux_out: list | None = None,
     ):
+        # `aux_out` matches the base signature so a direct
+        # `strategy.value_and_grad` call on an MoE config hits the curated
+        # error below, not an opaque TypeError (ADVICE r5 #1).
+        self._reject_moe(cfg)
         num_stages, num_micro = self.num_stages, self.num_microbatches
         padded = self.padded_layers(cfg.num_layers)
         per_stage = padded // num_stages
@@ -303,13 +317,19 @@ class Pipeline(Strategy):
             mb_local = inputs.shape[1]
 
             x0 = jnp.zeros((mb_local, seq, cfg.dim), cfg.compute_dtype)
+            # The three accumulators are carried (and returned) as shape
+            # (1,), not scalars: older jax (0.4.x) shard_map partial-eval
+            # mishandles rank-0 autodiff residuals that forward to other
+            # residual slots (structural _SpecError in the transpose; fixed
+            # upstream). Rank-1 costs nothing and sidesteps the bug on the
+            # pinned-jax deployment image.
             carry0 = (
                 x0,
                 jnp.zeros((mb_local, seq), jnp.bool_),  # threaded pad mask
                 jnp.zeros((mb_local, seq), jnp.int32),  # threaded targets
-                jnp.float32(0),  # loss sum
-                jnp.float32(0),  # valid-token count
-                jnp.float32(0),  # correct count
+                jnp.zeros((1,), jnp.float32),  # loss sum
+                jnp.zeros((1,), jnp.float32),  # valid-token count
+                jnp.zeros((1,), jnp.float32),  # correct count
             )
 
             def step(carry, t):
@@ -486,9 +506,11 @@ class Pipeline(Strategy):
             loss_sum = jax.lax.psum(loss_sum, axes)
             count = jax.lax.psum(count, axes)
             correct = jax.lax.psum(correct, axes)
-            return loss_sum, count, correct
+            return loss_sum, count, correct  # each shape (1,), see carry0
 
-        loss_sum, count, correct = schedule(layers, rest, inputs, positions, masks, tgts)
+        loss_sum, count, correct = (
+            x[0] for x in schedule(layers, rest, inputs, positions, masks, tgts)
+        )
         denom = jnp.maximum(count, 1.0)
         loss = loss_sum / denom
         accuracy = correct / denom * 100.0
@@ -558,6 +580,7 @@ class Pipeline1F1B(Pipeline):
     def value_and_grad(self, params, cfg: gpt.GPTConfig, batch, targets, rng=None):
         """(loss, grads) for one global batch — the hook make_step_fns uses
         instead of jax.value_and_grad (tpukit/train.py)."""
+        self._reject_moe(cfg)  # fail loudly from any entry point (ADVICE r5 #1)
         num_stages, num_micro = self.num_stages, self.num_microbatches
         padded = self.padded_layers(cfg.num_layers)
         per_stage = padded // num_stages

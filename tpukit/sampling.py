@@ -216,6 +216,7 @@ def generate(
     buf[0, :prompt_len] = ids
 
     eos = tokenizer.eos_token_id
+    explicit_cache = use_cache  # caller's stated choice, before auto-resolve
     if use_cache is None:
         # Measured on v5e: the cached path wins on long buffers (O(S) vs
         # O(S^2) per token) but its per-step cache updates cost more than
@@ -226,7 +227,19 @@ def generate(
         use_cache = buf.shape[1] >= 512 and cfg.num_experts == 0
     if temperature > 0.0:
         # sampling runs the naive full-reforward loop only (the cached loop
-        # is greedy-only); an explicit use_cache=True is overridden
+        # is greedy-only) — fail loudly on an EXPLICITLY requested cached
+        # path instead of silently dropping the caller's choice (ADVICE
+        # r5 #4; the repo's fail-loud convention). An auto-resolved
+        # use_cache (the caller passed None) downgrades silently as before:
+        # the caller stated no preference to violate.
+        if explicit_cache:
+            raise ValueError(
+                f"use_cache=True is greedy-only: the KV-cached decode loop "
+                f"does not implement sampling (temperature={temperature}). "
+                f"Drop use_cache (or pass use_cache=False) to sample via "
+                f"the exact full-reforward loop, or set temperature=0 for "
+                f"cached greedy decoding."
+            )
         use_cache = False
     if use_cache:
         buf, length = _decode_loop_cached(

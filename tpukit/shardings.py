@@ -66,6 +66,13 @@ class Strategy:
     # (checked at use time, never forces the kernel ON) is the operational
     # escape hatch back to the unfused XLA path.
     fused_head = True
+    # HLO collective kinds this strategy is EXPECTED to emit in its compiled
+    # train step (tpukit/obs/xla.py COLLECTIVE_OPS names). Telemetry
+    # (`fit()`'s kind="xla" record, tools/report.py) reports the measured
+    # per-kind comm bytes from the compiled module and flags kinds outside
+    # this set — a sharding regression (say, FSDP silently all-gathering
+    # the whole state per step) shows up as a surprise entry, not a hunch.
+    comm_ops: tuple[str, ...] = ()
 
     def __init__(self, mesh: Mesh | None = None):
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh(None)
@@ -217,6 +224,7 @@ class DataParallel(Strategy):
     the replicated-param + sharded-batch specs."""
 
     name = "ddp"
+    comm_ops = ("all-reduce",)  # the grad psum
 
     def __init__(self, mesh: Mesh | None = None):
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"data": -1})
@@ -230,6 +238,8 @@ class FSDP(Strategy):
     params, grads and optimizer state over the `data` axis, via GSPMD."""
 
     name = "fsdp"
+    # param all-gather at use, grad reduce-scatter, small-tensor all-reduce
+    comm_ops = ("all-gather", "reduce-scatter", "all-reduce")
 
     # Twin of size_based_auto_wrap_policy(min_num_params=100): tensors below
     # the threshold stay replicated (main-fsdp.py:62).
@@ -342,6 +352,11 @@ class ContextParallel(Strategy):
         self.attention = attention
         if attention == "ulysses":
             self.name = "cp-ulysses"
+            # head re-partition round trips; grad psum over the mesh
+            self.comm_ops = ("all-to-all", "all-reduce")
+        else:
+            # K/V ring hops; grad psum over the mesh
+            self.comm_ops = ("collective-permute", "all-reduce")
         self.seq_size = self.mesh.shape["seq"]
         self.data_size = self.mesh.shape.get("data", 1)
 
@@ -410,8 +425,18 @@ class ContextParallel(Strategy):
 
     def loss_fn(
         self, params, cfg: gpt.GPTConfig, batch, targets,
-        with_accuracy: bool = False, rng=None,
+        with_accuracy: bool = False, rng=None, aux_out: list | None = None,
     ):
+        # `aux_out` matches the base signature so direct
+        # `strategy.value_and_grad` calls on an MoE config reach the curated
+        # error below instead of an opaque TypeError (ADVICE r5 #1);
+        # validate_config raises the same message for the fit() entry point.
+        if cfg.num_experts > 0:
+            raise ValueError(
+                "ContextParallel does not support MoE configs (the routed "
+                "dispatch is token-global, the CP loss is seq-sharded) — "
+                "use ExpertParallel (main-moe.py) for num_experts > 0"
+            )
         seq_len = batch["input_ids"].shape[1]
         if seq_len % self.seq_size:
             raise ValueError(
@@ -433,7 +458,7 @@ class ContextParallel(Strategy):
         batch_spec = self.batch_spec()
         axes = tuple(self.mesh.axis_names)
 
-        from jax import shard_map
+        from tpukit.compat import shard_map
 
         def local_loss(params, input_ids, position_ids, mask, tgts):
             if rng is None:
@@ -515,6 +540,7 @@ class TensorParallel(Strategy):
 
     name = "tp"
     fused_head = False  # the vocab-sharded head wants the GSPMD matmul path
+    comm_ops = ("all-reduce",)  # post-attention + post-MLP Megatron pair
 
     def __init__(self, mesh: Mesh | None = None):
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"model": -1})
@@ -527,8 +553,16 @@ class TensorParallel(Strategy):
 
     def loss_fn(
         self, params, cfg: gpt.GPTConfig, batch, targets,
-        with_accuracy: bool = False, rng=None,
+        with_accuracy: bool = False, rng=None, aux_out: list | None = None,
     ):
+        # Same aux_out contract as the base class so MoE configs fail with
+        # the curated error from any entry point (ADVICE r5 #1).
+        if cfg.num_experts > 0:
+            raise ValueError(
+                "TensorParallel does not support MoE configs (the Megatron "
+                "column/row rules assume dense FFN kernels) — use "
+                "ExpertParallel (main-moe.py) for num_experts > 0"
+            )
         # The fused qkv matmul would concatenate kernels along their sharded
         # (column) axis, forcing a weight re-layout every step — keep the
         # three Megatron column-parallel matmuls instead.
@@ -603,6 +637,8 @@ class ExpertParallel(Strategy):
     """
 
     name = "ep"
+    # token dispatch/combine round trips; trunk-grad psum over the mesh
+    comm_ops = ("all-to-all", "all-reduce")
 
     def __init__(self, mesh: Mesh | None = None):
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"expert": -1})

@@ -54,7 +54,18 @@ from tpukit.flags import TrainFlags
 from tpukit.loader import DataLoader
 from tpukit.mesh import initialize_runtime, is_process_zero
 from tpukit.model import gpt
-from tpukit.profiling import MFUMeter, StepLogger, trace
+from tpukit.obs import (
+    Heartbeat,
+    MFUMeter,
+    SpanTimeline,
+    SpikeSentinel,
+    StepLogger,
+    compiled_stats,
+    format_breakdown,
+    global_norms,
+    live_memory_stats,
+    trace,
+)
 from tpukit.sampling import generate_batch
 from tpukit.shardings import Strategy
 
@@ -83,7 +94,10 @@ def make_optimizer(learning_rate: float) -> optax.GradientTransformation:
     return optax.adamw(learning_rate, b1=0.9, b2=0.999, eps=1e-8, weight_decay=1e-2)
 
 
-def make_step_fns(cfg: gpt.GPTConfig, optimizer, strategy: Strategy, state_shapes, seed: int = 0):
+def make_step_fns(
+    cfg: gpt.GPTConfig, optimizer, strategy: Strategy, state_shapes,
+    seed: int = 0, log_grad_norms: bool = False,
+):
     """Build jitted train/eval steps with the strategy's shardings applied.
 
     GSPMD reads the in/out shardings and inserts the collectives: grad psum
@@ -95,6 +109,12 @@ def make_step_fns(cfg: gpt.GPTConfig, optimizer, strategy: Strategy, state_shape
     strategy's loss — active in training, never in eval (the reference's
     train()/eval() mode split, models/gpt.py:31,65). With dropout off no rng
     is traced at all, so the compiled step is unchanged.
+
+    `log_grad_norms` (round-6 telemetry, --log_grad_norms): the train step
+    ADDITIONALLY returns `{grad,update,param}_norm` f32 scalars, computed
+    inside the same jitted program (the grads/updates are already live — no
+    second compilation, no extra pass). Off (default): the traced graph is
+    exactly the flag-free one, so the compiled HLO is byte-identical.
     """
     eval_cfg = cfg.replace(compute_dtype=jnp.bfloat16)  # eval autocast always on
     dropout_key = jax.random.PRNGKey(seed ^ 0x5EED) if cfg.dropout > 0 else None
@@ -114,10 +134,12 @@ def make_step_fns(cfg: gpt.GPTConfig, optimizer, strategy: Strategy, state_shape
         )
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        return (
-            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
-            loss,
+        new_state = TrainState(
+            params=params, opt_state=opt_state, step=state.step + 1
         )
+        if log_grad_norms:
+            return new_state, loss, global_norms(grads, updates, params)
+        return new_state, loss
 
     def eval_step(state: TrainState, batch, targets):
         state = strategy.to_compute(state)
@@ -133,10 +155,14 @@ def make_step_fns(cfg: gpt.GPTConfig, optimizer, strategy: Strategy, state_shape
     batch_sh = strategy.batch_sharding()
     repl = strategy.replicated()
 
+    train_out_sh = (state_sharding, repl)
+    if log_grad_norms:
+        norm_sh = {k: repl for k in ("grad_norm", "update_norm", "param_norm")}
+        train_out_sh = (state_sharding, repl, norm_sh)
     train_step = jax.jit(
         train_step,
         in_shardings=(state_sharding, batch_sh, batch_sh),
-        out_shardings=(state_sharding, repl),
+        out_shardings=train_out_sh,
         donate_argnums=(0,),
     )
     eval_step = jax.jit(
@@ -367,7 +393,8 @@ def fit(
     init_fn = partial(create_train_state, cfg=cfg, optimizer=optimizer, strategy=strategy)
     state_shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(flags.seed))
     train_step, eval_step, state_sharding = make_step_fns(
-        cfg, optimizer, strategy, state_shapes, seed=flags.seed
+        cfg, optimizer, strategy, state_shapes, seed=flags.seed,
+        log_grad_norms=flags.log_grad_norms,
     )
 
     # Initialize directly into the sharded layout (no host-side giant pytree).
@@ -404,6 +431,49 @@ def fit(
     seq = flags.sequence_length - 1  # model sees S-1 after the shift
     meter = MFUMeter(cfg, seq)
     logger = StepLogger(flags.metrics_log if p0 else "")
+    # ---- telemetry (tpukit/obs, round 6) --------------------------------
+    spans = SpanTimeline()
+    # Sentinel runs on EVERY process with identical inputs (the window loss
+    # is a replicated global mean), so an "abort" decision is collective-
+    # consistent — each process checkpoints and raises in lockstep instead
+    # of process 0 abandoning a collective the others are blocked in.
+    sentinel = (
+        SpikeSentinel(flags.spike_threshold)
+        if flags.spike_threshold > 0
+        else None
+    )
+    heart = (
+        Heartbeat(flags.heartbeat_dir, timeout_s=flags.heartbeat_timeout)
+        if flags.heartbeat_dir
+        else None
+    )
+    spike_events = 0
+    # XLA static analysis (cost/memory/comm bytes) is captured once per
+    # compiled step function, lazily at its first batch (real avals in
+    # hand), and only when a metrics log is requested — with telemetry off
+    # nothing here touches the step functions.
+    xla_pending = {"train_step": train_step, "eval_step": eval_step}
+
+    def capture_xla(fn_name, *call_args):
+        jitted = xla_pending.pop(fn_name, None)
+        # p0-gated like the logger that consumes it: the analysis
+        # (as_text + HLO parse) is pure host work other processes would
+        # only discard. The AOT lower/compile it triggers is process-local,
+        # so skipping it off-p0 cannot desynchronize a multi-host run.
+        if jitted is None or not flags.metrics_log or not p0:
+            return
+        with spans.span("telemetry"):
+            structs = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), call_args
+            )
+            stats = compiled_stats(jitted, *structs)
+        if stats:
+            expected = getattr(strategy, "comm_ops", ())
+            logger.log(
+                kind="xla", fn=fn_name, strategy=strategy.name,
+                expected_comm_ops=list(expected), **stats,
+            )
+
     epochs = num_epochs if num_epochs is not None else flags.epochs
     checkpoint_path = None
 
@@ -411,6 +481,8 @@ def fit(
     # possible resume, then pure host arithmetic) so periodic checkpointing
     # never forces a per-step `int(state.step)` sync inside the hot loop.
     host_step = int(state.step)
+    if heart is not None:
+        heart.beat(host_step)  # liveness file exists before the first compile
 
     maybe_nojit = jax.disable_jit() if flags.disable_compile else contextlib.nullcontext()
     # Debug toolchain (SURVEY §5): abort with a traceback at the first
@@ -437,12 +509,30 @@ def fit(
             bar = tqdm(train_loader, disable=not p0)
             bar.set_description(f"[training] Epoch {epoch+1}/{epochs} | loss: ?????")
             running = None
-            for i, raw in enumerate(bar):
-                batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
-                if host_batch is not None:
-                    batch, targets = host_batch(batch, targets)
-                batch, targets = make_global_batch(batch_sh, batch, targets)
-                state, loss = train_step(state, batch, targets)
+            norms = None  # on-device window norms when --log_grad_norms
+            it = iter(bar)
+            i = -1
+            while True:
+                # Explicit iterator so the loader wait is a measured span —
+                # a data-bound run shows up as a "data" slice of the window
+                # instead of silently deflating tokens/sec.
+                with spans.span("data"):
+                    try:
+                        raw = next(it)
+                    except StopIteration:
+                        break
+                    i += 1
+                    batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
+                    if host_batch is not None:
+                        batch, targets = host_batch(batch, targets)
+                with spans.span("h2d"):
+                    batch, targets = make_global_batch(batch_sh, batch, targets)
+                capture_xla("train_step", state_shapes, batch, targets)
+                with spans.span("step"):
+                    if flags.log_grad_norms:
+                        state, loss, norms = train_step(state, batch, targets)
+                    else:
+                        state, loss = train_step(state, batch, targets)
                 host_step += 1
                 running = loss if running is None else running + loss
                 # Honest throughput (VERDICT r2 #8): count only original
@@ -457,20 +547,80 @@ def fit(
                 else:
                     meter.update(real_rows * loader_procs * targets.shape[1])
                 if i > 0 and not i % PRINT_FREQ:
-                    avg = float(running) / PRINT_FREQ  # one D2H sync per window
+                    with spans.span("sync"):
+                        avg = float(running) / PRINT_FREQ  # one D2H sync per window
+                        norm_vals = (
+                            {k: float(v) for k, v in norms.items()}
+                            if norms is not None
+                            else {}
+                        )
+                    win = spans.window()
                     bar.set_description(
                         f"[training] Epoch {epoch+1}/{epochs} | loss: {avg:.3f}"
                     )
-                    logger.log(
+                    record = dict(
                         kind="train", epoch=epoch, step=host_step, loss=avg,
                         tokens_per_sec=meter.tokens_per_sec, mfu=meter.mfu,
+                        goodput=win["goodput"], spans=win["fractions"],
+                        window_s=win["total_s"], **norm_vals,
                     )
+                    hbm = live_memory_stats()
+                    if hbm:
+                        record["hbm"] = hbm
+                    logger.log(**record)
                     running = None
+                    if heart is not None:
+                        heart.beat(host_step)
+                        if p0:
+                            # step_lag = one window: SPMD lockstep keeps
+                            # healthy processes equal, so a process a full
+                            # window behind (e.g. restarted onto an old
+                            # checkpoint) is worth naming
+                            stragglers = heart.check(step_lag=PRINT_FREQ)
+                            if stragglers:
+                                logger.log(
+                                    kind="straggler", step=host_step,
+                                    stragglers=stragglers,
+                                )
+                                print(f"heartbeat: straggling processes {stragglers}")
+                    if sentinel is not None:
+                        event = sentinel.observe(avg, host_step)
+                        if event is not None:
+                            spike_events += 1
+                            logger.log(
+                                kind="spike", action=flags.spike_action,
+                                **event.record(),
+                            )
+                            if p0:
+                                print(
+                                    f"loss sentinel: {event.kind} at step "
+                                    f"{event.step} (loss {event.loss:.4g})"
+                                )
+                            if flags.spike_action == "abort":
+                                # Preserve the blown-up state for autopsy,
+                                # then fail loudly. Collective-consistent:
+                                # every process observed the same replicated
+                                # loss and takes this branch together.
+                                with spans.span("checkpoint"):
+                                    checkpoint_path = (
+                                        ckpt_lib.save_auto(
+                                            state, format=flags.checkpoint_format
+                                        )
+                                        or checkpoint_path
+                                    )
+                                logger.close()
+                                raise RuntimeError(
+                                    f"loss sentinel aborted training: "
+                                    f"{event.kind} at step {event.step} "
+                                    f"(loss {event.loss:.6g}); state "
+                                    f"checkpointed at {checkpoint_path}"
+                                )
                 if flags.checkpoint_every and host_step % flags.checkpoint_every == 0:
-                    checkpoint_path = (
-                        ckpt_lib.save_auto(state, format=flags.checkpoint_format)
-                        or checkpoint_path
-                    )
+                    with spans.span("checkpoint"):
+                        checkpoint_path = (
+                            ckpt_lib.save_auto(state, format=flags.checkpoint_format)
+                            or checkpoint_path
+                        )
 
             # ---- validation ---------------------------------------------
             bar = tqdm(validation_loader, disable=not p0)
@@ -480,30 +630,33 @@ def fit(
             total_loss, total_acc, total_weight = 0.0, 0.0, 0.0
             eval_metrics = {"loss": float("nan"), "accuracy": float("nan")}
             for i, raw in enumerate(bar):
-                batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
-                if host_batch is not None:
-                    batch, targets = host_batch(batch, targets)
-                batch, targets = make_global_batch(batch_sh, batch, targets)
-                # Token-weighted epoch aggregate (VERDICT r3 #9): each batch's
-                # mean loss/accuracy weighs by its valid-token count, so a
-                # padded final batch no longer weighs like a full one (the
-                # reference's mean-of-batch-means, main-single.py:128-137, is
-                # exact only when batches divide evenly). Counted on the
-                # GLOBAL targets (a jitted reduction over the sharded array),
-                # so every process aggregates with the same weights — a
-                # host-local count would make ranks disagree about the epoch
-                # metric (caught by tests/test_multiprocess.py).
-                weight = float(_valid_count(targets))
-                loss, acc = eval_step(state, batch, targets)
-                if weight > 0.0:
-                    total_loss += float(loss) * weight
-                    total_acc += float(acc) * weight
-                    total_weight += weight
-                if total_weight > 0.0:
-                    eval_metrics = {
-                        "loss": total_loss / total_weight,
-                        "accuracy": total_acc / total_weight,
-                    }
+                with spans.span("eval"):
+                    batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
+                    if host_batch is not None:
+                        batch, targets = host_batch(batch, targets)
+                    batch, targets = make_global_batch(batch_sh, batch, targets)
+                    capture_xla("eval_step", state_shapes, batch, targets)
+                    # Token-weighted epoch aggregate (VERDICT r3 #9): each
+                    # batch's mean loss/accuracy weighs by its valid-token
+                    # count, so a padded final batch no longer weighs like a
+                    # full one (the reference's mean-of-batch-means,
+                    # main-single.py:128-137, is exact only when batches
+                    # divide evenly). Counted on the GLOBAL targets (a jitted
+                    # reduction over the sharded array), so every process
+                    # aggregates with the same weights — a host-local count
+                    # would make ranks disagree about the epoch metric
+                    # (caught by tests/test_multiprocess.py).
+                    weight = float(_valid_count(targets))
+                    loss, acc = eval_step(state, batch, targets)
+                    if weight > 0.0:
+                        total_loss += float(loss) * weight
+                        total_acc += float(acc) * weight
+                        total_weight += weight
+                    if total_weight > 0.0:
+                        eval_metrics = {
+                            "loss": total_loss / total_weight,
+                            "accuracy": total_acc / total_weight,
+                        }
                 bar.set_description(
                     f"[validation] Epoch {epoch+1}/{epochs} | "
                     f"loss: {eval_metrics['loss']:.3f}, accuracy: {eval_metrics['accuracy']:.2f}"
@@ -515,13 +668,28 @@ def fit(
             # clamp the decode budget so tiny --sequence_length debug
             # runs still fit a prompt in the position table
             gen_tokens = min(20, cfg.max_position_embeddings - 2)
-            texts = generate_samples(
-                strategy, state, cfg, tokenizer, max_new_tokens=gen_tokens
-            )
+            with spans.span("generate"):
+                texts = generate_samples(
+                    strategy, state, cfg, tokenizer, max_new_tokens=gen_tokens
+                )
             if p0:
                 print("Argmax sampling from model")
                 for text in texts:
                     print(text)
+
+            # ---- epoch wall-clock summary (span timeline): where the
+            # epoch's host time went, and the goodput fraction (share spent
+            # inside/waiting on the compiled steps) ------------------------
+            ep = spans.epoch()
+            logger.log(
+                kind="epoch", epoch=epoch, goodput=ep["goodput"],
+                total_s=ep["total_s"], seconds=ep["seconds"],
+                fractions=ep["fractions"],
+            )
+            if heart is not None:
+                heart.beat(host_step)
+            if p0:
+                print(f"epoch {epoch+1} wallclock: {format_breakdown(ep)}")
 
     # ---- final checkpoint (twin of main-single.py:146-151; format routed
     # by save_auto so sharded multi-host state never hits the consolidated
@@ -539,6 +707,7 @@ def fit(
         # exact global count (VERDICT r4 #6) — multi-process tests assert
         # ranks agree and match the dataset's real row total
         "train_tokens": meter.total_tokens,
+        "spike_events": spike_events,
     }
     if p0 and meter.tokens_per_sec:
         print(

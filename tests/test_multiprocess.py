@@ -255,19 +255,28 @@ def test_moe_two_process_world_matches_single(tmp_path):
 
 
 @pytest.mark.slow
-def test_fsdp_kill_midrun_resume(tmp_path):
+@pytest.mark.parametrize(
+    "writer_args", [[], ["--async_checkpoint"]], ids=["sync", "async"]
+)
+def test_fsdp_kill_midrun_resume(tmp_path, writer_args):
     """VERDICT r4 #3: the failure-recovery path, for real. Train a
     2-process FSDP world with periodic sharded checkpointing, SIGKILL both
     processes mid-epoch (right after the first atomic publish), plant a
     torn checkpoint directory (no manifest) plus a stale .tmp staging dir,
     relaunch with --resume latest — training must continue from the last
     PUBLISHED step (asserted via exact step arithmetic; picking either
-    decoy would break it or crash the restore)."""
+    decoy would break it or crash the restore).
+
+    The async variant (round 7) runs the SAME scenario through the
+    background writer: snapshots on the training thread, file-based
+    cross-process rendezvous, atomic publish — SIGKILL mid-save must still
+    leave only fully-published checkpoints ('async checkpoint never
+    tears')."""
     run_args = [
         "--dataset_slice", "2048",  # 32 steps/epoch at global batch 64
         "--checkpoint_every", "2",
         "--checkpoint_format", "sharded",
-    ]
+    ] + writer_args
     port = _free_port()
     procs = []
     for rank in range(2):

@@ -608,6 +608,103 @@ def test_report_flags_unexpected_collectives():
 
 
 # ---------------------------------------------------------------------------
+# round-7 satellites: line-buffered StepLogger, compile-cache accounting,
+# report.py prefetch rendering + --min_goodput gate
+# ---------------------------------------------------------------------------
+
+
+def test_steplogger_line_visible_without_close(tmp_path):
+    """Line-buffered single-write records: every logged line is durable on
+    disk immediately (no close/flush needed), so a killed run's log is
+    readable up to its last complete record."""
+    from tpukit.obs import StepLogger
+
+    path = tmp_path / "log.jsonl"
+    logger = StepLogger(str(path))
+    logger.log(kind="train", step=1, loss=2.5)
+    logger.log(kind="train", step=2, loss=2.25)
+    lines = path.read_text().splitlines()  # BEFORE close
+    assert [json.loads(l)["step"] for l in lines] == [1, 2]
+    logger.close()
+    logger.close()  # idempotent
+    StepLogger("").log(kind="noop")  # empty path stays a no-op
+
+
+def test_compile_cache_misses_then_hits(tmp_path):
+    """enable_compilation_cache mid-process: first compile misses and
+    writes an entry; an identical fresh jit then HITS — counted through
+    jax's own monitoring events."""
+    from tpukit.cache import enable_compilation_cache
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        stats = enable_compilation_cache(str(tmp_path / "cc"))
+        jax.jit(lambda x: x @ x + 5)(np.ones((32, 32), np.float32)).block_until_ready()
+        s1 = stats.stats()
+        assert s1["requests"] >= 1 and s1["misses"] >= 1
+        assert s1["new_entries"] >= 1  # the executable landed on disk
+
+        stats2 = enable_compilation_cache(str(tmp_path / "cc"))
+        jax.jit(lambda x: x @ x + 5)(np.ones((32, 32), np.float32)).block_until_ready()
+        s2 = stats2.stats()
+        assert s2["hits"] >= 1 and s2["misses"] == 0
+    finally:
+        # hand the suite back its conftest-configured cache
+        if prev_dir:
+            enable_compilation_cache(prev_dir, min_compile_time_secs=prev_min)
+        else:
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", prev_min
+            )
+
+
+def test_report_renders_prefetch_and_compile_cache():
+    from tools.report import summarize
+
+    recs = [
+        {
+            "kind": "train", "step": 8, "loss": 2.0, "goodput": 0.9,
+            "tokens_per_sec": 1000.0, "window_s": 2.0,
+            "spans": {"prefetch_stall": 0.05, "step": 0.2, "sync": 0.7,
+                      "other": 0.05},
+            "prefetch_stall_s": 0.1, "prefetch_occupancy": 1.8, "time": 0,
+        },
+        {
+            "kind": "compile_cache", "dir": "/x/cache", "entries": 5,
+            "new_entries": 2, "requests": 5, "hits": 3, "misses": 2,
+            "time": 1,
+        },
+    ]
+    text = summarize(recs)
+    assert "prefetch: stall 5.0% of window wall-clock" in text
+    assert "occupancy mean 1.80" in text
+    assert "compile cache" in text and "hits 3" in text and "misses 2" in text
+
+
+def test_report_min_goodput_gate(tmp_path):
+    from tools.report import check_min_goodput
+    from tools.report import main as report_main
+
+    recs = [
+        {"kind": "train", "step": 8, "loss": 2.0, "goodput": 0.9, "time": 0},
+        {"kind": "train", "step": 16, "loss": 1.9, "goodput": 0.7, "time": 1},
+    ]
+    ok, msg = check_min_goodput(recs, 0.75)  # mean 0.8
+    assert ok and "OK" in msg
+    ok, msg = check_min_goodput(recs, 0.85)
+    assert not ok and "FAIL" in msg
+    assert not check_min_goodput([{"kind": "epoch"}], 0.5)[0]  # no windows
+
+    log = tmp_path / "r.jsonl"
+    log.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    assert report_main([str(log), "--min_goodput", "0.75"]) == 0
+    assert report_main([str(log), "--min_goodput", "0.85"]) == 2
+    assert report_main([str(log)]) == 0  # gate off by default
+
+
+# ---------------------------------------------------------------------------
 # multi-host heartbeats, for real (reuses the 2-process world harness)
 # ---------------------------------------------------------------------------
 

@@ -53,6 +53,28 @@ def make_batch(rng, vocab: int, batch: int, seq: int):
     return model_batch, np.roll(ids, -1, axis=1).astype(np.int32)
 
 
+def setup_step(cfg, strategy=None, lr=1e-4, seed=0):
+    """State init + jitted step fns + sharded placement — the setup block
+    every bench/probe repeats (bench.py's headline/long-context/offload/MoE
+    probes and every ladder rung). Returns
+    `(train_step, state, state_shapes, state_sharding)` ready for
+    `time_windows`; warmup/compile happens there."""
+    import jax
+
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    strategy = strategy if strategy is not None else SingleDevice()
+    optimizer = make_optimizer(lr)
+    state = create_train_state(
+        jax.random.PRNGKey(seed), cfg, optimizer, strategy=strategy
+    )
+    shapes = jax.eval_shape(lambda: state)
+    train_step, _, state_sharding = make_step_fns(cfg, optimizer, strategy, shapes)
+    state = jax.device_put(state, state_sharding)
+    return train_step, state, shapes, state_sharding
+
+
 def time_windows(step_fn, state, model_batch, targets, steps: int,
                  windows: int, warmup: int = 3):
     """Warm up (compile), then time `windows` windows of `steps` steps.
@@ -78,13 +100,10 @@ def time_windows(step_fn, state, model_batch, targets, steps: int,
 
 def bench_shape(name, dim, heads, head_dim, layers, seq, batch, remat, scan,
                 steps=8, windows=3):
-    import jax
     import jax.numpy as jnp
 
     from tpukit.model import GPTConfig
     from tpukit.obs import peak_flops_per_chip, train_flops_per_token
-    from tpukit.shardings import SingleDevice
-    from tpukit.train import create_train_state, make_optimizer, make_step_fns
 
     cfg = GPTConfig(
         dim=dim,
@@ -97,11 +116,7 @@ def bench_shape(name, dim, heads, head_dim, layers, seq, batch, remat, scan,
         remat_layers=remat,
         scan_layers=scan,
     )
-    optimizer = make_optimizer(1e-4)
-    state = create_train_state(jax.random.PRNGKey(0), cfg, optimizer)
-    shapes = jax.eval_shape(lambda: state)
-    train_step, _, state_sharding = make_step_fns(cfg, optimizer, SingleDevice(), shapes)
-    state = jax.device_put(state, state_sharding)
+    train_step, state, _, _ = setup_step(cfg)
 
     model_batch, targets = make_batch(np.random.RandomState(0), cfg.vocab_size, batch, seq)
     times, state, _ = time_windows(

@@ -6,11 +6,14 @@ The trainer's StepLogger writes one JSON object per line, discriminated by
 `kind` (docs/DESIGN.md "Telemetry & observability"): "train" window records
 (loss, tokens/sec, MFU, goodput breakdown, HBM gauges, optional norms),
 "xla" once-per-compile static analysis (FLOPs, bytes, peak memory,
-per-collective comm bytes), "validation"/"epoch" per-epoch records, and
-"spike"/"straggler" sentinel events. This tool needs NOTHING but the file —
-no jax import, so it runs anywhere the log was copied to.
+per-collective comm bytes), "validation"/"epoch" per-epoch records,
+"spike"/"straggler" sentinel events, and "compile_cache" hit/miss counts.
+Train windows from a prefetching run additionally carry
+`prefetch_stall_s`/`prefetch_occupancy` (round-7 host overlap), rendered
+in the training section. This tool needs NOTHING but the file — no jax
+import, so it runs anywhere the log was copied to.
 
-Usage: python tools/report.py run.jsonl
+Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
 """
 
 from __future__ import annotations
@@ -102,6 +105,25 @@ def summarize(records: list[dict]) -> str:
                     span_keys.setdefault(k, []).append(v)
             w("  span split (mean): "
               + _fmt_fractions({k: sum(v) / len(v) for k, v in span_keys.items()}))
+        # round-7 prefetch gauges: how much of the window wall-clock the
+        # training thread still blocked on input AFTER overlap, and how
+        # full the prefetch buffer ran (near-depth = producer ahead,
+        # near-0 = input bound)
+        pstall = [
+            (r["prefetch_stall_s"], r.get("window_s", 0.0))
+            for r in train
+            if r.get("prefetch_stall_s") is not None
+        ]
+        if pstall:
+            tot_win = sum(wsec for _, wsec in pstall)
+            share = sum(s for s, _ in pstall) / tot_win if tot_win else 0.0
+            occ = [
+                r["prefetch_occupancy"] for r in train
+                if r.get("prefetch_occupancy") is not None
+            ]
+            w(f"  prefetch: stall {share * 100:.1f}% of window wall-clock"
+              + (f"   buffer occupancy mean {sum(occ) / len(occ):.2f}"
+                 if occ else ""))
         hbm_peaks = [
             (r.get("hbm") or {}).get("peak_bytes_in_use")
             or (r.get("hbm") or {}).get("bytes_in_use")
@@ -174,18 +196,54 @@ def summarize(records: list[dict]) -> str:
         w("== stragglers ==")
         for r in stragglers:
             w(f"  step {r.get('step', '?')}: {r.get('stragglers')}")
+    cache_rows = _rows(records, "compile_cache")
+    if cache_rows:
+        w("== compile cache ==")
+    for r in cache_rows:
+        hits, misses = r.get("hits"), r.get("misses")
+        w(f"  {r.get('dir', '?')}: "
+          + (f"hits {hits}  misses {misses}  "
+             if hits is not None else "")
+          + f"entries {r.get('entries', '-')} (+{r.get('new_entries', 0)} this run)")
     return "\n".join(out)
+
+
+def check_min_goodput(records: list[dict], threshold: float) -> tuple[bool, str]:
+    """Cheap perf-regression gate (`--min_goodput`): mean goodput over the
+    run's train windows must reach `threshold`. Returns (ok, message)."""
+    gp = [
+        r["goodput"] for r in _rows(records, "train")
+        if r.get("goodput") is not None
+    ]
+    if not gp:
+        return False, "--min_goodput: no train windows with goodput in the log"
+    mean_gp = sum(gp) / len(gp)
+    verdict = "OK" if mean_gp >= threshold else "FAIL"
+    return mean_gp >= threshold, (
+        f"--min_goodput {verdict}: mean goodput {mean_gp:.3f} over "
+        f"{len(gp)} windows (threshold {threshold:.3f})"
+    )
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("log", help="metrics JSONL written via --metrics_log")
+    ap.add_argument(
+        "--min_goodput", type=float, default=None, metavar="FRACTION",
+        help="assert mean train-window goodput >= FRACTION (exit 2 below "
+        "it) — a cheap perf regression gate for CI",
+    )
     args = ap.parse_args(argv)
     records = load(args.log)
     if not records:
         print(f"{args.log}: no records", file=sys.stderr)
         return 1
     print(summarize(records))
+    if args.min_goodput is not None:
+        ok, msg = check_min_goodput(records, args.min_goodput)
+        print(msg, file=sys.stdout if ok else sys.stderr)
+        if not ok:
+            return 2
     return 0
 
 

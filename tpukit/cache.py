@@ -1,0 +1,118 @@
+"""Persistent XLA compilation cache wiring + hit/miss accounting.
+
+JAX ships a content-addressed on-disk cache of compiled executables
+(`jax_compilation_cache_dir`); with it enabled, a repeat run of the same
+program skips XLA compilation entirely — on the bench ladder shapes that
+is tens of seconds of host time per shape. tpukit exposes it as
+`--compilation_cache_dir` (fit) and `--compilation_cache_dir` on bench.py,
+and counts hits/misses through JAX's own monitoring events so the run can
+LOG whether it actually hit (`kind="compile_cache"` JSONL record) instead
+of leaving cache effectiveness to wall-clock guessing.
+
+Counting: jax records `/jax/compilation_cache/compile_requests_use_cache`
+once per cache-eligible compile and `/jax/compilation_cache/cache_hits`
+once per hit, so `misses = requests - hits`. One module-level listener is
+installed at most once per process; `enable_compilation_cache` returns a
+stats handle that reports deltas since it was created, so nested scopes
+(bench probes, repeated fit calls) each see their own counts.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_REQUEST_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+
+_lock = threading.Lock()
+_counts = {"hits": 0, "requests": 0}
+_listener_installed = False
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if event == _HIT_EVENT:
+        _counts["hits"] += 1
+    elif event == _REQUEST_EVENT:
+        _counts["requests"] += 1
+
+
+def _install_listener() -> bool:
+    global _listener_installed
+    with _lock:
+        if _listener_installed:
+            return True
+        try:
+            jax.monitoring.register_event_listener(_on_event)
+        except Exception:
+            return False  # monitoring API unavailable: fall back to file counts
+        _listener_installed = True
+        return True
+
+
+class CompileCacheStats:
+    """Delta view of the cache counters since construction, plus the cache
+    directory's entry count (works even when monitoring is unavailable)."""
+
+    def __init__(self, cache_dir: str, listener_ok: bool):
+        self.cache_dir = cache_dir
+        self._listener_ok = listener_ok
+        self._base = dict(_counts)
+        self._entries0 = self._entry_count()
+
+    def _entry_count(self) -> int:
+        try:
+            return sum(
+                1 for name in os.listdir(self.cache_dir)
+                if not name.startswith(".")
+            )
+        except OSError:
+            return 0
+
+    def stats(self) -> dict:
+        """JSONL-ready summary: requests/hits/misses observed since this
+        handle was created, and on-disk entry growth."""
+        entries = self._entry_count()
+        out = {
+            "dir": self.cache_dir,
+            "entries": entries,
+            "new_entries": entries - self._entries0,
+        }
+        if self._listener_ok:
+            requests = _counts["requests"] - self._base["requests"]
+            hits = _counts["hits"] - self._base["hits"]
+            out.update(requests=requests, hits=hits, misses=requests - hits)
+        return out
+
+
+def enable_compilation_cache(
+    cache_dir: str, min_compile_time_secs: float = 0.0
+) -> CompileCacheStats:
+    """Point JAX's persistent compilation cache at `cache_dir` (created if
+    missing) and return a hit/miss stats handle. `min_compile_time_secs=0`
+    caches every compile — the right default here, since the whole point is
+    skipping repeat work and tpukit's test/bench compiles are often under
+    jax's 1s default threshold."""
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    os.makedirs(cache_dir, exist_ok=True)
+    listener_ok = _install_listener()
+    previous = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", min_compile_time_secs
+    )
+    if previous != cache_dir:
+        # jax initializes its cache object AT MOST ONCE per process, at the
+        # first compile — if anything compiled before this call (or an
+        # earlier call pointed elsewhere), the new dir silently never takes
+        # effect. reset_cache() returns the module to its pristine state so
+        # the next compile re-initializes against the dir set above.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass  # private API moved: the dir still applies to fresh processes
+    return CompileCacheStats(cache_dir, listener_ok)

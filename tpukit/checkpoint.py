@@ -27,8 +27,11 @@ timestamp string sort.
 
 from __future__ import annotations
 
+import functools
 import os
 import re
+import threading
+import time
 from pathlib import Path
 
 import jax
@@ -238,6 +241,38 @@ def _leaf_paths(tree) -> list[str]:
     return ["/".join(str(k) for k in path) for path, _ in flat]
 
 
+def _shard_blocks(state, copy: bool = False):
+    """D2H snapshot of every replica-0 shard block this process addressably
+    owns: `{"<leaf-idx>|<starts>": ndarray}` plus the manifest dict. This is
+    the part of a sharded save that must read device memory — it runs on the
+    TRAINING thread; the returned host blocks are what a background writer
+    publishes. `copy=True` forces materialized copies: on CPU backends
+    `np.asarray` of a device buffer can be a zero-copy VIEW, and the async
+    writer's blocks must survive the next donated train step reusing those
+    buffers."""
+    import numpy as np
+
+    leaves = [_as_jax_array(l) for l in jax.tree_util.tree_leaves(state)]
+    blocks = {}
+    for i, arr in enumerate(leaves):
+        for shard in arr.addressable_shards:
+            if shard.replica_id != 0:
+                continue  # exactly one process writes each block
+            starts = [s.start or 0 for s in shard.index] if shard.index else []
+            key = f"{i}|{','.join(map(str, starts))}"
+            blocks[key] = (
+                np.array(shard.data) if copy else np.asarray(shard.data)
+            )
+    manifest = {
+        "nprocs": jax.process_count(),
+        "paths": _leaf_paths(state),
+        "leaves": [
+            {"shape": list(l.shape), "dtype": str(l.dtype)} for l in leaves
+        ],
+    }
+    return blocks, manifest
+
+
 def save_sharded(state, directory: str | os.PathLike = "checkpoints", name: str | None = None) -> Path:
     """Write a sharded checkpoint. Every process participates; returns the
     checkpoint directory. Atomic publish: everything is written into a
@@ -273,25 +308,10 @@ def save_sharded(state, directory: str | os.PathLike = "checkpoints", name: str 
     tmp.mkdir(parents=True, exist_ok=True)
     sync_global_devices("sharded_ckpt_mkdir")
 
-    leaves = [_as_jax_array(l) for l in jax.tree_util.tree_leaves(state)]
-    blocks = {}
-    for i, arr in enumerate(leaves):
-        for shard in arr.addressable_shards:
-            if shard.replica_id != 0:
-                continue  # exactly one process writes each block
-            starts = [s.start or 0 for s in shard.index] if shard.index else []
-            key = f"{i}|{','.join(map(str, starts))}"
-            blocks[key] = np.asarray(shard.data)
+    blocks, manifest = _shard_blocks(state)
     np.savez(tmp / f"shard-{jax.process_index():05d}.npz", **blocks)
 
     if is_process_zero():
-        manifest = {
-            "nprocs": jax.process_count(),
-            "paths": _leaf_paths(state),
-            "leaves": [
-                {"shape": list(l.shape), "dtype": str(l.dtype)} for l in leaves
-            ],
-        }
         (tmp / "manifest.json").write_text(json.dumps(manifest))
     sync_global_devices("sharded_ckpt_written")
     if is_process_zero():
@@ -429,6 +449,206 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
         else:
             restored.append(_as_jax_array(full))
     return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+# ---------------------------------------------------------------------------
+# Async (non-blocking) checkpointing — round-7 host overlap.
+#
+# The sync writers above charge the WHOLE save — device->host gather, msgpack
+# encode / npz write, publish — to the training loop, visible as the
+# `checkpoint` span in the goodput breakdown. The async writer splits a save
+# at the only boundary that must see live device state: the snapshot (D2H
+# reads + host copies) stays on the training thread, everything after is
+# pure host I/O on a background thread that overlaps subsequent steps.
+#
+# The background half must NOT issue device collectives (sync_global_devices
+# is one): a collective enqueued off the training thread can interleave
+# differently with training collectives on different processes and deadlock
+# the pod. The sharded format's cross-process rendezvous is therefore
+# FILE-based here — each process renames its shard into the staging dir
+# atomically, and process 0 publishes only once all `nprocs` shard files
+# exist. SIGKILL at any instant still leaves only the previous published
+# checkpoint or the new one, never a torn directory (the atomic tmp+rename
+# contract of the sync writers, exercised by the kill-midrun harness in
+# tests/test_multiprocess.py).
+# ---------------------------------------------------------------------------
+
+
+def _write_consolidated_blob(host_state, path: Path) -> None:
+    """Background half of an async consolidated save: encode + atomic write
+    of an already-snapshotted host pytree. Pure host work."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    blob = serialization.to_bytes(host_state)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_bytes(blob)
+    tmp.rename(path)  # atomic publish: no torn checkpoints on crash
+
+
+def _publish_sharded_snapshot(
+    blocks, manifest, base: Path, timeout: float = 600.0
+) -> None:
+    """Background half of an async sharded save: write this process's shard
+    atomically, then (process 0) wait for every process's shard file and
+    publish the directory. All rendezvous is via the shared filesystem — no
+    device collectives off the training thread.
+
+    Same-step re-save (`base` already published): the state at a given step
+    is deterministic within a run, so the published directory already holds
+    these bytes — skip, exactly like the sync writer's keep-the-published-
+    directory policy.
+
+    Stale `.tmp` staging dirs (a crashed prior save at the same step) need
+    no rmtree here, unlike the sync writer: a shard file only ever appears
+    under its final name via the atomic `.part` rename, so a stale
+    `shard-*.npz` is always a COMPLETE write from the crashed attempt —
+    and a crash-then-resume of the same run reproduces the same state at
+    the same step, so publishing stale-alongside-fresh shards publishes
+    identical bytes. The remaining hazard is the one the sync writer also
+    only warns about: reusing an old checkpoints dir across runs with
+    DIFFERENT config/data, where a same-step stale shard could win — fresh
+    runs must start with a clean checkpoints dir."""
+    import json
+
+    import numpy as np
+
+    if base.exists():
+        return  # same-step re-save: already durable (see docstring)
+    tmp = base.with_name(base.name + ".tmp")
+    tmp.mkdir(parents=True, exist_ok=True)
+    pid = jax.process_index()
+    final = tmp / f"shard-{pid:05d}.npz"
+    part = tmp / f"shard-{pid:05d}.npz.part"
+    with open(part, "wb") as f:
+        np.savez(f, **blocks)
+    part.rename(final)  # atomic: a half-written shard never looks complete
+    deadline = time.monotonic() + timeout
+    if not is_process_zero():
+        # Publish barrier for every process: wait() on ANY host must mean
+        # "the checkpoint directory exists" — otherwise a non-zero host
+        # could return from fit() (or report an abort checkpoint path) and
+        # read `latest` while process 0 is still publishing, resuming a
+        # step behind the rest of the pod.
+        while not base.exists():
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"async sharded checkpoint {base}: timed out after "
+                    f"{timeout}s waiting for process 0 to publish"
+                )
+            time.sleep(0.05)
+        return
+    expected = [tmp / f"shard-{p:05d}.npz" for p in range(manifest["nprocs"])]
+    while True:
+        missing = [str(p.name) for p in expected if not p.exists()]
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"async sharded checkpoint {base}: timed out after {timeout}s "
+                f"waiting for shard files {missing} (is the checkpoint "
+                f"directory on a filesystem shared by all hosts?)"
+            )
+        time.sleep(0.05)
+    mpath = tmp / "manifest.json"
+    mtmp = tmp / "manifest.json.part"
+    mtmp.write_text(json.dumps(manifest))
+    mtmp.rename(mpath)
+    if not base.exists():
+        tmp.rename(base)  # atomic publish
+
+
+class AsyncCheckpointer:
+    """Non-blocking checkpoint writer.
+
+    `save_auto` SNAPSHOTS on the calling (training) thread — the D2H reads
+    and buffer copies, the only part that must run before the next donated
+    train step reuses the state's device buffers — then hands the encode/
+    write/publish to a background thread and returns the path the write
+    will publish. A join barrier at the next save (and `wait()`, which fit
+    calls at exit and before abort-saves) keeps AT MOST ONE write in
+    flight and re-raises any background failure on the training thread, so
+    an async save error is never silently lost.
+
+    Durability is the sync writers' contract: atomic tmp+rename publish in
+    both formats — SIGKILL at any instant leaves the previous checkpoint or
+    the new one, never a torn file.
+    """
+
+    def __init__(self, shard_timeout: float = 600.0):
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self._shard_timeout = shard_timeout
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def wait(self) -> None:
+        """Join barrier: block until the in-flight write (if any) has
+        published, then re-raise its failure (if any)."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from err
+
+    def save_auto(
+        self,
+        state,
+        directory: str | os.PathLike = "checkpoints",
+        name: str | None = None,
+        format: str = "auto",
+    ) -> Path | None:
+        """Async twin of module-level `save_auto` (same routing, same return
+        convention). Blocks only for the previous write's join barrier plus
+        the snapshot; call `wait()` when durability must be certain."""
+        import numpy as np
+
+        self.wait()
+        if format == "auto":
+            format = "sharded" if needs_sharded(state) else "consolidated"
+        if format == "consolidated":
+            # Consolidated implies host-gatherable state (fully replicated
+            # in the multi-host case), so device_get is process-local and
+            # non-zero hosts can skip the whole snapshot — unlike the sync
+            # writer there is no collective barrier here to participate in.
+            if not is_process_zero():
+                return None
+            # np.array (copy) on top of device_get: on CPU backends the
+            # gather can return zero-copy views of buffers the next donated
+            # train step will overwrite.
+            host_state = jax.tree.map(np.array, jax.device_get(state))
+            nm = name or (step_name(state) + ".msgpack")
+            if not nm.endswith(".msgpack"):
+                nm += ".msgpack"
+            path = Path(directory).resolve() / nm
+            work = functools.partial(_write_consolidated_blob, host_state, path)
+        elif format == "sharded":
+            blocks, manifest = _shard_blocks(state, copy=True)
+            path = Path(directory).resolve() / (
+                (name or step_name(state)) + ".sharded"
+            )
+            work = functools.partial(
+                _publish_sharded_snapshot, blocks, manifest, path,
+                self._shard_timeout,
+            )
+        else:
+            raise ValueError(
+                f"format must be auto|consolidated|sharded, got {format!r}"
+            )
+
+        def run():
+            try:
+                work()
+            except BaseException as exc:  # noqa: BLE001 — re-raised at wait()
+                self._error = exc
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="tpukit-async-ckpt"
+        )
+        self._thread.start()
+        return path
 
 
 def latest_sharded(directory: str | os.PathLike = "checkpoints") -> Path | None:

@@ -19,6 +19,7 @@ accepts either a HuggingFace dataset or the fixture dataset.
 
 from __future__ import annotations
 
+import functools
 import os
 import re
 from typing import Optional, Union
@@ -219,7 +220,13 @@ _FIXTURE_TRAIN_SIZE = 4096
 _FIXTURE_VALIDATION_SIZE = 256
 
 
+@functools.lru_cache(maxsize=1)
 def _fixture_corpus() -> tuple[list[str], list[str]]:
+    """Memoized (round-7 host-pipeline hygiene): the corpus is deterministic
+    and BOTH get_dataset and get_tokenizer rebuild it on every fit() —
+    ~1.3s of pure host regeneration per run that repeat callers (bench
+    probes, the test suite's ~35 fits) were paying each time. Callers treat
+    the lists as read-only."""
     return (
         synthetic_stories(_FIXTURE_TRAIN_SIZE, seed=0),
         synthetic_stories(_FIXTURE_VALIDATION_SIZE, seed=1),

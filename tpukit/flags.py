@@ -48,7 +48,21 @@ class TrainFlags:
     # host-gathered (multi-host FSDP/pipeline), else the consolidated
     # msgpack the reference-style save produces. Force either explicitly.
     checkpoint_format: str = "auto"  # auto | consolidated | sharded
+    # Non-blocking checkpoint writes (round 7): snapshot on the training
+    # thread, encode/write/publish on a background thread with a join
+    # barrier at the next save/exit. Same formats, same atomic-publish
+    # durability; only the loop no longer stalls on disk.
+    async_checkpoint: bool = False
     resume: str = ""  # checkpoint path (either format) or "latest"
+    # Host input pipeline depth (round 7): a background thread runs
+    # prepare_batch + the strategy's host transform + global-batch H2D
+    # assembly this many batches ahead, overlapping the in-flight compiled
+    # step. 0 = the synchronous reference path (bit-identical losses).
+    prefetch: int = 2
+    # If set, JAX's persistent compilation cache lives here: repeat runs of
+    # the same program skip XLA recompiles, and fit logs a
+    # kind="compile_cache" hit/miss record.
+    compilation_cache_dir: str = ""
     profile_dir: str = ""  # if set, jax.profiler traces land here
     metrics_log: str = ""  # if set, JSONL step metrics land here
     # Debug toolchain (SURVEY §5 race-detection plan): aborts with a traceback
@@ -145,7 +159,13 @@ def build_parser(
         choices=("auto", "consolidated", "sharded"),
         default=defaults.checkpoint_format,
     )
+    parser.add_argument("--async_checkpoint", action="store_true")
     parser.add_argument("--resume", type=str, default=defaults.resume)
+    parser.add_argument("--prefetch", type=int, default=defaults.prefetch)
+    parser.add_argument(
+        "--compilation_cache_dir", type=str,
+        default=defaults.compilation_cache_dir,
+    )
     parser.add_argument("--profile_dir", type=str, default=defaults.profile_dir)
     parser.add_argument("--metrics_log", type=str, default=defaults.metrics_log)
     parser.add_argument("--debug_nans", action="store_true")

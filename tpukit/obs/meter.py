@@ -130,19 +130,29 @@ class StepLogger:
     Schema (docs/DESIGN.md "Telemetry & observability"): one JSON object
     per line, discriminated by `kind` — "train" window records, "validation"
     epoch records, "xla" once-per-compile static analysis, "epoch" span
-    summaries, "spike" sentinel events. `tools/report.py` renders a run.
+    summaries, "spike" sentinel events, "compile_cache" hit/miss counts.
+    `tools/report.py` renders a run.
+
+    Hot-loop I/O discipline (round 7): the stream is opened ONCE,
+    line-buffered, and each record is a single `write` of one complete
+    line — no explicit per-record flush call, no reopen. Line buffering
+    still pushes every record to the OS at its newline, so the worst a
+    crash can leave is one torn final line — exactly what report.py's
+    loader tolerates.
     """
 
     def __init__(self, path: str = ""):
-        self._f = open(path, "a") if path else None
+        # buffering=1 = line-buffered text: the newline inside the single
+        # write below is the flush point
+        self._f = open(path, "a", buffering=1) if path else None
 
     def log(self, **record):
         if self._f is None:
             return
         record.setdefault("time", time.time())
-        self._f.write(json.dumps(record) + "\n")
-        self._f.flush()
+        self._f.write(json.dumps(record) + "\n")  # one write per record
 
     def close(self):
         if self._f:
             self._f.close()
+            self._f = None

@@ -17,6 +17,11 @@ host time spent either feeding the device or waiting for it — everything
 else (data wait, H2D assembly, checkpoint I/O) is time the device is
 potentially idle. On a healthy run goodput is close to 1; a data-bound run
 shows it directly.
+
+With the round-7 input prefetcher on (`--prefetch N`, the default), the
+"data"/"h2d" phases move to a background thread and the loop's only input
+cost is the "prefetch_stall" span — the time the consumer actually blocked
+on the buffer (docs/DESIGN.md §7).
 """
 
 from __future__ import annotations

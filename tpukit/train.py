@@ -49,9 +49,11 @@ from tqdm import tqdm
 
 from tpukit import checkpoint as ckpt_lib
 from tpukit.batching import IGNORE_INDEX, prepare_batch
+from tpukit.cache import enable_compilation_cache
 from tpukit.data import get_dataset, get_tokenizer, transform_dataset
 from tpukit.flags import TrainFlags
 from tpukit.loader import DataLoader
+from tpukit.prefetch import HostPrefetcher
 from tpukit.mesh import initialize_runtime, is_process_zero
 from tpukit.model import gpt
 from tpukit.obs import (
@@ -173,7 +175,7 @@ def make_step_fns(
     return train_step, eval_step, state_sharding
 
 
-def make_global_batch(batch_sharding, model_batch, targets):
+def make_global_batch(batch_sharding, model_batch, targets, place: bool = False):
     """Assemble per-process host arrays into global device arrays.
 
     Single-process: identity (jit places numpy at the sharding). Multi-host
@@ -184,9 +186,22 @@ def make_global_batch(batch_sharding, model_batch, targets):
     per-rank DataLoader+DistributedSampler feeding (main-ddp.py:83-100);
     feeding the full global batch from every process would be rejected by
     a jit whose shardings span non-addressable devices.
+
+    `place=True` (the prefetch path) makes the single-process case an
+    explicit `jax.device_put` at the batch sharding instead of leaving the
+    H2D copy to the jit boundary — so the transfer itself happens on the
+    prefetch thread, ahead of the step that consumes it. Values are
+    bit-identical either way (the batch is integer/bool data placed at the
+    same sharding the jit would have used).
     """
     if jax.process_count() == 1:
-        return model_batch, targets
+        if not place:
+            return model_batch, targets
+
+        def conv(x):
+            return jax.device_put(x, batch_sharding)
+
+        return jax.tree.map(conv, model_batch), conv(targets)
 
     spec = batch_sharding.spec
     if len(spec) > 0 and spec[0] is not None:
@@ -311,6 +326,15 @@ def fit(
     """The shared training entry point every recipe calls."""
     initialize_runtime()
     p0 = is_process_zero()
+    if flags.prefetch < 0:
+        raise ValueError(f"--prefetch must be >= 0, got {flags.prefetch}")
+    # Persistent XLA compilation cache (round 7): repeat runs of the same
+    # program skip recompiles; hits/misses are logged at the end of the run.
+    cache_stats = (
+        enable_compilation_cache(flags.compilation_cache_dir)
+        if flags.compilation_cache_dir
+        else None
+    )
 
     tokenizer = get_tokenizer()
     tokenizer.pad_token_id = 2  # every recipe pins pad to 2 (main-single.py:23)
@@ -428,6 +452,28 @@ def fit(
     # Host-side batch transform (ContextParallel's zigzag permute — ADVICE
     # r4: in-jit it is a per-step cross-shard reshard collective).
     host_batch = strategy.host_batch_fn(cfg)
+
+    def host_pipeline(raw):
+        """The whole host side of one training batch — prepare, strategy
+        transform, global-array assembly WITH explicit device placement.
+        This is what the prefetch thread runs `--prefetch` batches ahead;
+        it is the same work the synchronous path's data+h2d spans time."""
+        b, t = prepare_batch(raw, tokenizer.pad_token_id)
+        if host_batch is not None:
+            b, t = host_batch(b, t)
+        b, t = make_global_batch(batch_sh, b, t, place=True)
+        return raw, b, t
+
+    # Checkpoint writer: the async writer snapshots on this thread and
+    # publishes from a background one (join barrier at the next save), so
+    # periodic saves stop stalling the step loop on encode+disk I/O.
+    async_saver = ckpt_lib.AsyncCheckpointer() if flags.async_checkpoint else None
+
+    def save_checkpoint(st):
+        if async_saver is not None:
+            return async_saver.save_auto(st, format=flags.checkpoint_format)
+        return ckpt_lib.save_auto(st, format=flags.checkpoint_format)
+
     seq = flags.sequence_length - 1  # model sees S-1 after the shift
     meter = MFUMeter(cfg, seq)
     logger = StepLogger(flags.metrics_log if p0 else "")
@@ -491,7 +537,11 @@ def fit(
     maybe_nans = (
         _debug_nans_scope() if flags.debug_nans else contextlib.nullcontext()
     )
-    with maybe_nojit, maybe_nans, trace(flags.profile_dir):
+    # _cleanup: any exception unwinding the loop (debug_nans aborts, device
+    # OOM, KeyboardInterrupt) must release the epoch's prefetch worker —
+    # close() is idempotent, so registering each epoch's prefetcher is safe.
+    with maybe_nojit, maybe_nans, trace(flags.profile_dir), \
+            contextlib.ExitStack() as _cleanup:
         for epoch in range(epochs):
             # ---- train ---------------------------------------------------
             train_loader.set_epoch(epoch)
@@ -506,27 +556,57 @@ def fit(
                 if hasattr(train_loader, "global_real_row_counts")
                 else None
             )
-            bar = tqdm(train_loader, disable=not p0)
+            # total=None for reduced-interface custom loaders (make_loaders
+            # contract: iterable + set_epoch; __len__ optional)
+            bar = tqdm(
+                total=len(train_loader) if hasattr(train_loader, "__len__") else None,
+                disable=not p0,
+            )
             bar.set_description(f"[training] Epoch {epoch+1}/{epochs} | loss: ?????")
             running = None
             norms = None  # on-device window norms when --log_grad_norms
-            it = iter(bar)
+            # Input source (round 7): with --prefetch N (default 2) a
+            # background thread runs the whole host pipeline N batches
+            # ahead, so loader wait + prepare + H2D assembly overlap the
+            # in-flight compiled step; the measured wait is the residual
+            # `prefetch_stall` span. --prefetch 0 is the synchronous
+            # reference path, bit-identical losses (tests/test_prefetch.py).
+            # One prefetcher per epoch: set_epoch has already run, and the
+            # epoch boundary flushes instead of buffering across epochs.
+            pf = (
+                HostPrefetcher(train_loader, host_pipeline, depth=flags.prefetch)
+                if flags.prefetch > 0
+                else None
+            )
+            if pf is not None:
+                _cleanup.callback(pf.close)
+            _cleanup.callback(bar.close)
+            it = iter(train_loader) if pf is None else None
             i = -1
             while True:
-                # Explicit iterator so the loader wait is a measured span —
-                # a data-bound run shows up as a "data" slice of the window
-                # instead of silently deflating tokens/sec.
-                with spans.span("data"):
-                    try:
-                        raw = next(it)
-                    except StopIteration:
-                        break
+                if pf is not None:
+                    with spans.span("prefetch_stall"):
+                        try:
+                            raw, batch, targets = next(pf)
+                        except StopIteration:
+                            break
                     i += 1
-                    batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
-                    if host_batch is not None:
-                        batch, targets = host_batch(batch, targets)
-                with spans.span("h2d"):
-                    batch, targets = make_global_batch(batch_sh, batch, targets)
+                else:
+                    # Explicit iterator so the loader wait is a measured
+                    # span — a data-bound run shows up as a "data" slice of
+                    # the window instead of silently deflating tokens/sec.
+                    with spans.span("data"):
+                        try:
+                            raw = next(it)
+                        except StopIteration:
+                            break
+                        i += 1
+                        batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
+                        if host_batch is not None:
+                            batch, targets = host_batch(batch, targets)
+                    with spans.span("h2d"):
+                        batch, targets = make_global_batch(batch_sh, batch, targets)
+                bar.update(1)
                 capture_xla("train_step", state_shapes, batch, targets)
                 with spans.span("step"):
                     if flags.log_grad_norms:
@@ -567,6 +647,18 @@ def fit(
                     hbm = live_memory_stats()
                     if hbm:
                         record["hbm"] = hbm
+                    if pf is not None:
+                        # buffer gauges: how long this thread actually
+                        # blocked on input (the honest residual of the old
+                        # data+h2d cost after overlap) and how full the
+                        # prefetch buffer ran (0 = starved, depth = ahead)
+                        pstats = pf.window_stats()
+                        record["prefetch_stall_s"] = round(
+                            win["seconds"].get("prefetch_stall", 0.0), 6
+                        )
+                        record["prefetch_occupancy"] = round(
+                            pstats["occupancy"], 3
+                        )
                     logger.log(**record)
                     running = None
                     if heart is not None:
@@ -603,11 +695,14 @@ def fit(
                                 # loss and takes this branch together.
                                 with spans.span("checkpoint"):
                                     checkpoint_path = (
-                                        ckpt_lib.save_auto(
-                                            state, format=flags.checkpoint_format
-                                        )
+                                        save_checkpoint(state)
                                         or checkpoint_path
                                     )
+                                    if async_saver is not None:
+                                        # abort must leave a DURABLE autopsy
+                                        async_saver.wait()
+                                # (the raise unwinds through _cleanup, which
+                                # closes this epoch's prefetcher and bar)
                                 logger.close()
                                 raise RuntimeError(
                                     f"loss sentinel aborted training: "
@@ -616,11 +711,16 @@ def fit(
                                     f"checkpointed at {checkpoint_path}"
                                 )
                 if flags.checkpoint_every and host_step % flags.checkpoint_every == 0:
+                    # Async: only the snapshot is charged here; the encode +
+                    # disk write overlaps the following steps.
                     with spans.span("checkpoint"):
                         checkpoint_path = (
-                            ckpt_lib.save_auto(state, format=flags.checkpoint_format)
-                            or checkpoint_path
+                            save_checkpoint(state) or checkpoint_path
                         )
+            # Close THIS epoch's prefetcher + bar now (pop_all keeps the
+            # fit-lifetime stack from accumulating dead objects across
+            # epochs; the stack still covers exceptional unwinds above).
+            _cleanup.pop_all().close()
 
             # ---- validation ---------------------------------------------
             bar = tqdm(validation_loader, disable=not p0)
@@ -694,9 +794,20 @@ def fit(
     # ---- final checkpoint (twin of main-single.py:146-151; format routed
     # by save_auto so sharded multi-host state never hits the consolidated
     # gather, VERDICT r2 #1) ----------------------------------------------
-    checkpoint_path = (
-        ckpt_lib.save_auto(state, format=flags.checkpoint_format) or checkpoint_path
-    )
+    checkpoint_path = save_checkpoint(state) or checkpoint_path
+    if async_saver is not None:
+        # exit barrier: fit() must not return before the last write is
+        # durable (the caller may read or delete the checkpoint next)
+        async_saver.wait()
+    if cache_stats is not None and p0:
+        cs = cache_stats.stats()
+        logger.log(kind="compile_cache", **cs)
+        print(
+            f"compile cache {cs['dir']}: "
+            f"{cs.get('hits', 0)} hits, "
+            f"{cs.get('misses', cs['new_entries'])} misses, "
+            f"{cs['entries']} entries (+{cs['new_entries']})"
+        )
     logger.close()
 
     metrics = {

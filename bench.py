@@ -13,7 +13,10 @@ compilation cache (`--compilation_cache_dir`, default `.jax_cache`), so a
 repeat bench run skips recompiles; hit/miss counts land in the JSON. The
 `host_pipeline` record measures the round-7 prefetch path: the same loader
 schedule + train step run synchronously and with `--prefetch`-style
-depth-2 overlap, reporting the input-share both ways and loss parity.
+depth-2 overlap, reporting the input-share both ways and loss parity. The
+`obs_overhead` record measures the round-8 failure-observability layer
+(flight-recorder ring + periodic in-jit divergence checksum) against the
+bare loop, with the same loss-parity proof.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -119,6 +122,75 @@ def bench_host_pipeline(cfg, strategy, batch, depth=2, steps=24):
         "prefetch_wall_s": round(win_pf["total_s"], 4),
         "loss_bit_identical": loss_sync == loss_pf,
         "final_loss": round(loss_pf, 6),
+    }
+
+
+def bench_obs_overhead(cfg, strategy, batch, steps=48, checksum_every=8):
+    """Flight-recorder + divergence-checksum overhead on the headline step.
+
+    Runs the same compiled train step over the same batch for `steps`
+    iterations twice, from identical initial states: once bare, once with
+    the round-8 observability layer active — a FlightRecorder record per
+    step plus an in-jit state checksum (with its D2H sync) every
+    `checksum_every` steps, the exact per-step work fit() adds with
+    `--divergence_check_freq`. Reports both walls, the overhead fraction
+    (the <1% claim docs/DESIGN.md makes, now measured per run), and
+    whether the final losses are bit-identical (they must be: the
+    recorder only observes, and the checksum is a separate jitted
+    program that never touches the training state).
+    """
+    import time as _time
+
+    import jax
+
+    from tools.bench_ladder import make_batch, setup_step
+    from tpukit.obs import FlightRecorder, format_checksum, make_state_checksum
+
+    seq = cfg.max_position_embeddings
+    rng = np.random.RandomState(3)
+    b, t = make_batch(rng, cfg.vocab_size, batch, seq - 1)
+
+    def run(instrumented: bool):
+        train_step, state, _, _ = setup_step(cfg, strategy)
+        state, loss = train_step(state, b, t)  # compile + warm, untimed
+        jax.block_until_ready(loss)
+        rec = FlightRecorder() if instrumented else None
+        checksum_fn = make_state_checksum() if instrumented else None
+        if checksum_fn is not None:
+            # compile the checksum program outside the timed window, the
+            # same one-off cost fit() pays at its first check step
+            jax.block_until_ready(checksum_fn(state)["params"])
+        last_ck = pending = None
+        t0 = _time.perf_counter()
+        for i in range(1, steps + 1):
+            state, loss = train_step(state, b, t)
+            if rec is not None:
+                rec.record("step", step=i)
+                if i % checksum_every == 0:
+                    pending = (i, checksum_fn(state))  # async dispatch
+            if i % checksum_every == 0:
+                float(loss)  # the PRINT_FREQ window sync BOTH paths pay
+                if pending is not None:
+                    # fit's deferred D2H read at the window boundary
+                    last_ck = format_checksum(pending[1])
+                    rec.record("divergence_check", step=pending[0], checksum=last_ck)
+                    pending = None
+        final = float(loss)  # drains the dispatch pipeline inside the timing
+        wall = _time.perf_counter() - t0
+        del state
+        return final, wall, last_ck
+
+    loss_off, wall_off, _ = run(False)
+    loss_on, wall_on, last_ck = run(True)
+    return {
+        "steps": steps,
+        "checksum_every": checksum_every,
+        "baseline_wall_s": round(wall_off, 4),
+        "instrumented_wall_s": round(wall_on, 4),
+        "overhead_frac": round((wall_on - wall_off) / wall_off, 4),
+        "loss_bit_identical": loss_off == loss_on,
+        "final_loss": round(loss_on, 6),
+        "last_checksum": last_ck,
     }
 
 
@@ -273,6 +345,15 @@ def main(argv=None):
         host_pipeline_err = repr(exc)
         print(f"host pipeline probe failed: {exc!r}", file=sys.stderr)
 
+    # Failure-observability overhead (round 8): recorder + periodic
+    # checksum cost vs the bare loop, with loss-parity proof.
+    obs_overhead, obs_overhead_err = None, None
+    try:
+        obs_overhead = bench_obs_overhead(cfg, strategy, batch)
+    except Exception as exc:
+        obs_overhead_err = repr(exc)
+        print(f"obs overhead probe failed: {exc!r}", file=sys.stderr)
+
     # Ladder rungs (VERDICT r4 #1): single-chip measurements of the
     # BASELINE configs 2-5 shapes at head_dim=64 — GPT-small/medium full,
     # GPT-large/XL as the 16-layer stage slices DESIGN.md §2 profiles.
@@ -306,6 +387,8 @@ def main(argv=None):
         "moe_error": moe_err,
         "host_pipeline": host_pipeline,
         "host_pipeline_error": host_pipeline_err,
+        "obs_overhead": obs_overhead,
+        "obs_overhead_error": obs_overhead_err,
         "ladder": ladder,
         "chips": n_dev,
         "device": jax.devices()[0].device_kind,

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Recipe 6 (tpukit extension): long-context training with ring attention.
+"""Recipe 7 (tpukit extension): long-context training with ring attention.
 
 The reference cookbook has no long-context story — its attention
 materializes the full S x S score tensor on one device and sequence length

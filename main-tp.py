@@ -1,5 +1,7 @@
 #!/usr/bin/env python
-"""Recipe 6: tensor-parallel training (beyond-reference; SURVEY §2.4 stretch).
+"""Recipe 6 (tpukit extension): tensor-parallel training (beyond-reference;
+SURVEY §2.4 stretch). The extension ladder is 6 = TP, 7 = ring/CP
+(main-ring.py), 8 = MoE/EP (main-moe.py), after the reference's five.
 
 The reference has no tensor-parallel recipe — its parallelism ladder stops
 at pipeline (SURVEY §2.4). On TPU, Megatron-style TP is pure shardings: q/k/v

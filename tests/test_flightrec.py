@@ -1,0 +1,596 @@
+"""Round-8 failure-observability tests: flight recorder, hang watchdog +
+diagnostics bundles, trace-on-anomaly, and cross-replica divergence
+detection (tpukit/obs/{recorder,watchdog,divergence}.py; docs/DESIGN.md §8).
+
+The acceptance bar from the issue: a hung step must produce a bundle on
+disk (with all-thread stacks, ring records, heartbeat snapshot) within
+--hang_timeout and tools/flightview.py must render it; the divergence
+checksum must be bit-stable across identical replicas, flip on a single
+perturbed element, leave the train step's HLO byte-identical when off,
+and the recorder ring must bound memory.
+"""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpukit.obs import (
+    AnomalyTracer,
+    FlightRecorder,
+    HangWatchdog,
+    Heartbeat,
+    format_checksum,
+    make_state_checksum,
+)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_ring_bounds_memory():
+    """The ring evicts oldest records at capacity — a long run holds
+    exactly `capacity` records, whatever was recorded."""
+    rec = FlightRecorder(capacity=16)
+    for i in range(100):
+        rec.record("step", step=i)
+    assert len(rec) == 16
+    assert rec.total_recorded == 100
+    snap = rec.snapshot()
+    assert [r["step"] for r in snap] == list(range(84, 100))  # newest 16
+    assert all(r["kind"] == "step" and "t" in r for r in snap)
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder(capacity=0)
+
+
+def test_recorder_snapshot_safe_under_concurrent_records():
+    """snapshot() (the watchdog thread) must never see a torn ring while
+    the training thread keeps appending — deque iteration during append
+    raises without the lock."""
+    rec = FlightRecorder(capacity=64)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            rec.record("step", step=i)
+            i += 1
+
+    def reader():
+        try:
+            for _ in range(200):
+                snap = rec.snapshot()
+                # records are well-formed and in order
+                steps = [r["step"] for r in snap]
+                assert steps == sorted(steps)
+        except Exception as exc:  # pragma: no cover - the failure path
+            errors.append(exc)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        reader()
+    finally:
+        stop.set()
+        t.join()
+    assert not errors
+
+
+# ---------------------------------------------------------------------------
+# hang watchdog + bundles
+# ---------------------------------------------------------------------------
+
+
+def _wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def test_watchdog_fires_on_hung_step_and_bundle_is_complete(tmp_path):
+    """Armed + overrun -> a bundle lands within ~the timeout, holding
+    all-thread stacks, the ring, the heartbeat snapshot, probes, and the
+    config; tools/flightview.py renders it without error."""
+    rec = FlightRecorder()
+    for i in range(5):
+        rec.record("step", step=i)
+    hb = Heartbeat(tmp_path / "hb", process_index=0, process_count=1)
+    hb.beat(4, checksum="aa:bb", checksum_step=4)
+    wd = HangWatchdog(
+        tmp_path / "dbg", timeout_s=0.3, recorder=rec, heartbeat=hb,
+        probes={
+            "ok": lambda: {"buffered": 2},
+            "broken": lambda: (_ for _ in ()).throw(RuntimeError("probe boom")),
+        },
+        config={"hang_timeout": 0.3, "debug_dir": str(tmp_path / "dbg")},
+    )
+    try:
+        t0 = time.monotonic()
+        wd.arm(5)
+        assert _wait_for(lambda: wd.dumps, timeout=5.0)
+        elapsed = time.monotonic() - t0
+        # fires within the timeout plus one poll tick (not, say, 10x late)
+        assert elapsed < 0.3 * 3 + 1.0
+        assert wd.hang_count == 1
+    finally:
+        wd.close()
+
+    bundle = json.loads(wd.dumps[0].read_text())
+    assert bundle["reason"] == "hang" and bundle["step"] == 5
+    assert bundle["stuck_for_s"] >= 0.3
+    # all-thread stacks: this (main) thread + the watchdog's own monitor
+    names = list(bundle["stacks"])
+    assert any(n.startswith("MainThread") for n in names)
+    assert any("tpukit-watchdog" in n for n in names)
+    assert all(isinstance(f, list) and f for f in bundle["stacks"].values())
+    # ring contents rode along
+    assert [r["step"] for r in bundle["ring"]] == list(range(5))
+    assert bundle["ring_total_recorded"] == 5
+    # heartbeat snapshot with the divergence checksum fields
+    assert bundle["heartbeats"]["0"]["step"] == 4
+    assert bundle["heartbeats"]["0"]["checksum"] == "aa:bb"
+    # probes: values captured, errors stringified (never aborting the dump)
+    assert bundle["inflight"]["ok"] == {"buffered": 2}
+    assert "probe boom" in bundle["inflight"]["broken"]
+    assert bundle["config"]["hang_timeout"] == 0.3
+
+    # the renderer consumes it end to end
+    from tools import flightview
+
+    assert flightview.main([str(wd.dumps[0])]) == 0
+    text = flightview.render(bundle)
+    for needle in ("hang", "MainThread", "flight recorder", "heartbeats"):
+        assert needle in text
+    # directory mode resolves to the newest bundle
+    assert flightview.main([str(tmp_path / "dbg")]) == 0
+
+
+def test_watchdog_disarm_and_rearm_protocol(tmp_path):
+    """disarm() before the deadline prevents the dump; every arm() resets
+    the clock, so a loop of healthy steps re-arming never fires."""
+    wd = HangWatchdog(tmp_path / "dbg", timeout_s=0.25)
+    try:
+        wd.arm(1)
+        wd.disarm()
+        time.sleep(0.5)
+        assert not wd.dumps
+        # healthy cadence: re-arm faster than the timeout
+        for step in range(8):
+            wd.arm(step)
+            time.sleep(0.05)
+        wd.disarm()
+        assert not wd.dumps and wd.hang_count == 0
+    finally:
+        wd.close()
+
+
+def test_watchdog_trigger_and_dump_budget(tmp_path):
+    """trigger() dumps synchronously (the sentinel path); the shared
+    max_dumps budget bounds a flapping sentinel."""
+    rec = FlightRecorder()
+    wd = HangWatchdog(tmp_path / "dbg", timeout_s=0.0, recorder=rec, max_dumps=2)
+    try:
+        p1 = wd.trigger("spike", step=10, loss=9.5)
+        p2 = wd.trigger("divergence", step=11)
+        p3 = wd.trigger("spike", step=12)
+        assert p1 is not None and p2 is not None
+        assert p3 is None  # budget spent
+        assert len(wd.dumps) == 2
+        b = json.loads(p1.read_text())
+        assert b["reason"] == "spike" and b["loss"] == 9.5
+        # timeout 0: no monitor thread was started
+        assert wd._thread is None
+    finally:
+        wd.close()
+    with pytest.raises(ValueError, match="timeout"):
+        HangWatchdog(tmp_path / "dbg2", timeout_s=-1)
+
+
+# ---------------------------------------------------------------------------
+# trace-on-anomaly
+# ---------------------------------------------------------------------------
+
+
+def test_anomaly_tracer_arms_exactly_once(tmp_path):
+    tr = AnomalyTracer(tmp_path / "tr", steps=2)
+    assert not tr.maybe_start()  # not armed yet: no-op
+    assert tr.trigger("spike") is True
+    assert tr.trigger("nan") is False  # second anomaly: already armed
+    assert tr.reason == "spike"
+    assert tr.maybe_start() is True
+    assert tr.tracing
+    assert tr.maybe_start() is False  # already tracing
+    assert tr.step() is False  # 1 of 2
+    assert tr.step() is True  # 2 of 2 -> stopped
+    assert tr.done and not tr.tracing
+    # a one-shot: nothing re-arms it
+    assert tr.trigger("spike") is False
+    assert not tr.maybe_start()
+    # the capture actually wrote profiler artifacts
+    assert any((tmp_path / "tr").rglob("*"))
+    with pytest.raises(ValueError, match="step count"):
+        AnomalyTracer(tmp_path, steps=0)
+
+
+# ---------------------------------------------------------------------------
+# divergence checksums
+# ---------------------------------------------------------------------------
+
+
+def _tiny_state(tiny_config, seed=0):
+    from tpukit.train import create_train_state, make_optimizer
+
+    return create_train_state(
+        jax.random.PRNGKey(seed), tiny_config, make_optimizer(1e-3)
+    )
+
+
+def test_checksum_bit_stable_across_identical_replicas(tiny_config):
+    """Two replicas built the same way (the DP contract: replicated state)
+    must produce the SAME checksum — and recomputing it must too."""
+    fn = make_state_checksum()
+    a = format_checksum(fn(_tiny_state(tiny_config)))
+    b = format_checksum(fn(_tiny_state(tiny_config)))
+    assert a == b
+    assert format_checksum(fn(_tiny_state(tiny_config))) == a  # idempotent
+    # and it actually depends on the values, not just the structure
+    c = format_checksum(fn(_tiny_state(tiny_config, seed=1)))
+    assert c != a
+
+
+def test_checksum_fires_on_single_element_perturbation(tiny_config):
+    """One element nudged anywhere — params or opt state, even by 1 ulp —
+    flips the corresponding checksum half (XOR of bit patterns: no
+    float-sum cancellation)."""
+    fn = make_state_checksum()
+    state = _tiny_state(tiny_config)
+    base = fn(state)
+
+    k = state.params["layers"]["attn"]["q"]["kernel"]
+    new_layers = jax.tree_util.tree_map(lambda x: x, state.params["layers"])
+    new_layers["attn"]["q"]["kernel"] = k.at[0, 1, 2].set(
+        jnp.nextafter(k[0, 1, 2], jnp.float32(1e9))
+    )
+    perturbed = state.replace(params={**state.params, "layers": new_layers})
+    got = fn(perturbed)
+    assert int(got["params"]) != int(base["params"])
+    assert int(got["opt_state"]) == int(base["opt_state"])  # untouched half
+
+    mu = state.opt_state[0].mu
+    new_mu = jax.tree_util.tree_map(lambda x: x, mu)
+    new_mu["lm_head"]["kernel"] = new_mu["lm_head"]["kernel"].at[3, 4].add(1e-6)
+    new_inner = state.opt_state[0]._replace(mu=new_mu)
+    got2 = fn(state.replace(opt_state=(new_inner,) + tuple(state.opt_state[1:])))
+    assert int(got2["opt_state"]) != int(base["opt_state"])
+    assert int(got2["params"]) == int(base["params"])
+
+
+def test_divergence_check_leaves_train_step_hlo_byte_identical(tiny_config):
+    """The --log_grad_norms discipline, re-verified for the checksum: it is
+    a separate jitted program, so compiling the train step before vs after
+    building+running the checksum yields byte-identical optimized HLO (and
+    the same output arity)."""
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    opt = make_optimizer(1e-3)
+    shapes = jax.eval_shape(
+        lambda: create_train_state(jax.random.PRNGKey(0), tiny_config, opt)
+    )
+    batch = {
+        "input_ids": jax.ShapeDtypeStruct((4, 16), np.int32),
+        "position_ids": jax.ShapeDtypeStruct((4, 16), np.int32),
+        "mask": jax.ShapeDtypeStruct((4, 16), np.bool_),
+    }
+    targets = jax.ShapeDtypeStruct((4, 16), np.int32)
+
+    step_off, _, _ = make_step_fns(tiny_config, opt, SingleDevice(), shapes)
+    hlo_off = step_off.lower(shapes, batch, targets).compile().as_text()
+
+    # build AND run the checksum program (divergence "on"), then compile
+    # the train step again: byte-identical
+    fn = make_state_checksum()
+    format_checksum(fn(_tiny_state(tiny_config)))
+    step_on, _, _ = make_step_fns(tiny_config, opt, SingleDevice(), shapes)
+    hlo_on = step_on.lower(shapes, batch, targets).compile().as_text()
+    assert hlo_on == hlo_off
+    out_off = jax.eval_shape(step_off, shapes, batch, targets)
+    assert len(out_off) == 2  # arity untouched — no smuggled outputs
+
+
+def test_heartbeat_divergence_detection_across_replicas(tmp_path, tiny_config):
+    """The cross-replica wire: each process publishes its checksum through
+    its beat file; process 0 names the minority at any step where the
+    checksums disagree — and skewed steps are never compared."""
+    fn = make_state_checksum()
+    healthy = format_checksum(fn(_tiny_state(tiny_config)))
+    state = _tiny_state(tiny_config)
+    new_layers = jax.tree_util.tree_map(lambda x: x, state.params["layers"])
+    new_layers["norm1"]["scale"] = new_layers["norm1"]["scale"].at[0, 0].add(1e-3)
+    diverged = format_checksum(
+        fn(state.replace(params={**state.params, "layers": new_layers}))
+    )
+    assert diverged != healthy
+
+    hbs = [
+        Heartbeat(tmp_path, process_index=i, process_count=3, timeout_s=60)
+        for i in range(3)
+    ]
+    # all agree at step 8: quiet
+    for hb in hbs:
+        hb.beat(8, checksum=healthy, checksum_step=8)
+    assert hbs[0].check_divergence() == []
+    # replica 2 diverges at step 16
+    hbs[0].beat(16, checksum=healthy, checksum_step=16)
+    hbs[1].beat(16, checksum=healthy, checksum_step=16)
+    hbs[2].beat(16, checksum=diverged, checksum_step=16)
+    got = hbs[0].check_divergence()
+    assert got == [{
+        "process": 2, "checksum_step": 16,
+        "checksum": diverged, "expected": healthy,
+    }]
+    # skew: replica 2 still reporting step 16 while others moved to 24 —
+    # different steps are not comparable, so no (false) mismatch either way
+    hbs[0].beat(24, checksum=healthy, checksum_step=24)
+    hbs[1].beat(24, checksum=healthy, checksum_step=24)
+    got = hbs[0].check_divergence()
+    assert got == []
+
+
+# ---------------------------------------------------------------------------
+# fit() end to end: hung step -> watchdog -> bundle -> flightview; and the
+# injected-divergence path through the heartbeat files
+# ---------------------------------------------------------------------------
+
+
+class _Loader:
+    """Minimal make_loaders-contract loader over fixed raw batches, with an
+    optional hang: iteration `hang_at` blocks until a hang bundle appears
+    in `debug_dir` (i.e. until the watchdog has demonstrably fired), then
+    the remaining batches stream normally so fit() finishes its epoch."""
+
+    def __init__(self, batches, hang_at=None, debug_dir=None, timeout_s=60.0):
+        self.batches = batches
+        self.hang_at = hang_at
+        self.debug_dir = Path(debug_dir) if debug_dir else None
+        self.timeout_s = timeout_s
+        self.hung_for: float | None = None
+
+    def set_epoch(self, epoch):
+        pass
+
+    def __len__(self):
+        return len(self.batches)
+
+    def __iter__(self):
+        for i, b in enumerate(self.batches):
+            if i == self.hang_at:
+                t0 = time.monotonic()
+                deadline = t0 + self.timeout_s
+                while time.monotonic() < deadline and not list(
+                    self.debug_dir.glob("bundle-*-hang-*.json")
+                ):
+                    time.sleep(0.05)
+                self.hung_for = time.monotonic() - t0
+            yield b
+
+
+def _raw_batches(n, batch, seq, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.randint(3, vocab, size=(batch, seq)).astype(np.int32)
+        out.append(
+            {"input_ids": ids, "attention_mask": np.ones_like(ids)}
+        )
+    return out
+
+
+def _obs_flags(tmp, **kw):
+    from tpukit.flags import TrainFlags
+
+    defaults = dict(
+        batch_size=8, epochs=1, sequence_length=33, dim=32, head_dim=8,
+        heads=4, num_layers=2, learning_rate=1e-3, dataset_slice="64",
+        num_workers=0, disable_amp=True, seed=0, prefetch=0,
+        metrics_log=str(tmp / "run.jsonl"),
+        heartbeat_dir=str(tmp / "hb"), debug_dir=str(tmp / "dbg"),
+    )
+    defaults.update(kw)
+    return TrainFlags(**defaults)
+
+
+@pytest.fixture(scope="module")
+def hang_run(tmp_path_factory):
+    """One fit() whose 3rd training iteration hangs until the watchdog
+    fires, then recovers and finishes — exercising hang detection, bundle
+    dump, hang-surfacing in the JSONL, and trace-on-anomaly (the hang
+    recovery is the first anomaly) in a single run."""
+    import os
+
+    from tpukit.train import fit
+    from tpukit.shardings import SingleDevice
+
+    tmp = tmp_path_factory.mktemp("hang")
+    flags = _obs_flags(tmp, hang_timeout=1.0, trace_on_anomaly=2)
+    loaders = {}
+
+    def make_loaders(fl, tokenizer, strategy):
+        train = _Loader(
+            _raw_batches(12, fl.batch_size, fl.sequence_length, tokenizer.vocab_size),
+            hang_at=2, debug_dir=flags.debug_dir,
+        )
+        val = _Loader(
+            _raw_batches(2, fl.batch_size, fl.sequence_length, tokenizer.vocab_size, seed=1)
+        )
+        loaders["train"] = train
+        return train, val
+
+    cwd = os.getcwd()
+    os.chdir(tmp)  # checkpoints/ lands in tmp
+    try:
+        result = fit(flags, SingleDevice(), make_loaders=make_loaders)
+    finally:
+        os.chdir(cwd)
+    records = [
+        json.loads(line)
+        for line in (tmp / "run.jsonl").read_text().splitlines()
+    ]
+    return flags, result, records, tmp, loaders["train"]
+
+
+def test_fit_hung_step_dumps_bundle_within_timeout(hang_run):
+    flags, _, _, tmp, train_loader = hang_run
+    bundles = sorted((tmp / "dbg").glob("bundle-*-hang-*.json"))
+    assert bundles, "watchdog never fired on the hung step"
+    # the loader unblocked BECAUSE the bundle appeared — i.e. the watchdog
+    # fired while the step was actually hung, within timeout + poll slack
+    assert train_loader.hung_for is not None
+    assert train_loader.hung_for < flags.hang_timeout * 3 + 2.0
+
+    bundle = json.loads(bundles[0].read_text())
+    assert bundle["reason"] == "hang"
+    # all-thread stacks, with the training thread blocked in the loader
+    main = next(
+        frames for name, frames in bundle["stacks"].items()
+        if name.startswith("MainThread")
+    )
+    assert any("__iter__" in line or "_Loader" in str(line) for line in main)
+    assert any("tpukit-watchdog" in n for n in bundle["stacks"])
+    # ring holds the pre-hang step records
+    kinds = [r["kind"] for r in bundle["ring"]]
+    assert "step" in kinds
+    # heartbeat snapshot (the beat written before the first compile)
+    assert "0" in bundle["heartbeats"]
+    # config + in-flight probes made it in
+    assert bundle["config"]["hang_timeout"] == flags.hang_timeout
+    assert "async_checkpoint_in_flight" in bundle["inflight"]
+
+
+def test_fit_hang_surfaces_in_jsonl_and_arms_trace(hang_run):
+    _, _, records, _, _ = hang_run
+    wd = [r for r in records if r["kind"] == "watchdog"]
+    assert any(r.get("event") == "hang" for r in wd)
+    hang = next(r for r in wd if r.get("event") == "hang")
+    assert hang["hangs"] >= 1 and hang["bundles"]
+    # the hang recovery was the run's first anomaly: trace armed once,
+    # started, and stopped after trace_on_anomaly steps
+    tr = [r for r in records if r["kind"] == "anomaly_trace"]
+    events = [r["event"] for r in tr]
+    assert events.count("armed") == 1
+    assert events.count("started") == 1
+    assert events.count("stopped") == 1
+    started = next(r for r in tr if r["event"] == "started")
+    stopped = next(r for r in tr if r["event"] == "stopped")
+    assert stopped["step"] - started["step"] + 1 == 2  # K=2 traced steps
+
+
+def test_fit_hang_run_renders_in_tools(hang_run):
+    from tools import flightview
+    from tools.report import load, summarize
+
+    flags, _, _, tmp, _ = hang_run
+    # flightview renders the bundle (newest-in-dir mode) without error
+    assert flightview.main([str(tmp / "dbg")]) == 0
+    text = summarize(load(str(tmp / "run.jsonl")))
+    assert "watchdog" in text and "HANG" in text
+    assert "anomaly trace" in text
+
+
+def test_fit_trains_to_completion_after_hang(hang_run):
+    """The watchdog is advisory: the recovered run finishes its epoch and
+    the final state/checkpoint are intact."""
+    _, result, records, _, _ = hang_run
+    assert int(result.state.step) == 12
+    assert any(r["kind"] == "validation" for r in records)
+
+
+@pytest.fixture(scope="module")
+def divergence_run(tmp_path_factory):
+    """fit() with --divergence_check_freq on, plus a planted beat file
+    from a fake process 1 whose checksum at step 8 disagrees — the
+    process-0 window check must flag it, log it, and dump a bundle."""
+    import os
+
+    from tpukit.train import fit
+    from tpukit.shardings import SingleDevice
+
+    tmp = tmp_path_factory.mktemp("div")
+    # 24 steps -> windows at 8 and 16: the stale planted mismatch is still
+    # on disk at the second window, which must NOT re-report it (dedupe)
+    flags = _obs_flags(
+        tmp, divergence_check_freq=4, dataset_slice="192", batch_size=8,
+    )
+    hb_dir = Path(flags.heartbeat_dir)
+    hb_dir.mkdir(parents=True, exist_ok=True)
+    # the first window closes at host_step 8 with checksum_step 8 (freq 4
+    # divides 8); the imposter claims a different state at that exact step
+    (hb_dir / "heartbeat-p00001.json").write_text(json.dumps({
+        "process": 1, "step": 8, "time": time.time() + 3600,
+        "checksum": "deadbeef:deadbeef", "checksum_step": 8,
+    }))
+    cwd = os.getcwd()
+    os.chdir(tmp)
+    try:
+        result = fit(flags, SingleDevice())
+    finally:
+        os.chdir(cwd)
+    records = [
+        json.loads(line)
+        for line in (tmp / "run.jsonl").read_text().splitlines()
+    ]
+    return flags, result, records, tmp
+
+
+def test_fit_divergence_check_records_and_detection(divergence_run):
+    flags, _, records, tmp = divergence_run
+    checks = [r for r in records if r["kind"] == "divergence_check"]
+    assert checks, "no periodic checksum records"
+    assert all(r["step"] % flags.divergence_check_freq == 0 for r in checks)
+    # every checksum is the replicated-state format
+    assert all(
+        len(r["checksum"]) == 17 and ":" in r["checksum"] for r in checks
+    )
+    div = [r for r in records if r["kind"] == "divergence"]
+    assert div, "planted mismatching replica was not detected"
+    m = div[0]["mismatches"][0]
+    # two processes, one planted mismatch: with no majority the tie breaks
+    # deterministically by checksum string, so either side may be named —
+    # what matters is that the disagreeing PAIR at step 8 was flagged
+    assert m["checksum_step"] == 8
+    assert m["process"] in (0, 1)
+    assert "deadbeef:deadbeef" in (m["checksum"], m["expected"])
+    assert m["checksum"] != m["expected"]
+    # the SAME mismatch is still on disk at the next window (beats
+    # republish their latest checksum) but is reported exactly once
+    assert len(div) == 1
+    # and the bundle budget was charged once, not once per window
+    assert len(list((tmp / "dbg").glob("bundle-*-divergence-*.json"))) == 1
+    # a bundle was dumped for the divergence
+    assert list((tmp / "dbg").glob("bundle-*-divergence-*.json"))
+    # and the run's own beat file carries its checksum for peers to read
+    beat = json.loads(
+        (Path(flags.heartbeat_dir) / "heartbeat-p00000.json").read_text()
+    )
+    assert beat.get("checksum") and beat.get("checksum_step") is not None
+
+
+def test_fit_divergence_report_renders(divergence_run):
+    from tools.report import load, summarize
+
+    flags, _, _, tmp = divergence_run
+    text = summarize(load(str(tmp / "run.jsonl")))
+    assert "DIVERGENCE" in text
+    assert "divergence checks" in text
+    assert "deadbeef" in text

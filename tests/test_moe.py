@@ -201,6 +201,70 @@ def test_moe_capacity_drop_is_residual_passthrough(cfg):
     )
 
 
+def test_moe_aux_loss_masks_pad_positions(cfg):
+    """ADVICE r5 #2: the load-balance statistics exclude pad positions and
+    normalize by the real-token count, so a padded batch reports the SAME
+    aux loss as the unpadded rows alone — pads can no longer dilute the
+    balance signal. moe_aux_mask_pads=False restores the old any-position
+    average for pre-masking curve comparisons."""
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(3, SEQ, cfg.dim).astype(np.float32))
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    layer0 = jax.tree.map(lambda t: t[0], params["layers"])
+    real = SEQ - 8
+    pad_mask = jnp.zeros((3, SEQ), bool).at[:, real:].set(True)
+    no_pads_wide = jnp.zeros((3, SEQ), bool)
+    no_pads_trunc = jnp.zeros((3, real), bool)
+
+    # unpadded batches: masked path == maskless path (bit-near)
+    _, aux_masked = _apply_moe_ffn(layer0, cfg, x, None, True, pad_mask=no_pads_wide)
+    _, aux_plain = _apply_moe_ffn(layer0, cfg, x, None, True)
+    np.testing.assert_allclose(float(aux_masked), float(aux_plain), atol=1e-6)
+
+    # padded batch == the same rows truncated to their real tokens (the
+    # dispatch is width-invariant, so only the statistics are at stake)
+    _, aux_pad = _apply_moe_ffn(layer0, cfg, x, None, True, pad_mask=pad_mask)
+    _, aux_trunc = _apply_moe_ffn(
+        layer0, cfg, x[:, :real], None, True, pad_mask=no_pads_trunc
+    )
+    np.testing.assert_allclose(float(aux_pad), float(aux_trunc), atol=1e-6)
+
+    # the old behavior is preserved behind the config flag, and it really
+    # is different under padding (the r5 #2 dilution this fixes)
+    old = cfg.replace(moe_aux_mask_pads=False)
+    _, aux_old = _apply_moe_ffn(layer0, old, x, None, True, pad_mask=pad_mask)
+    _, aux_old_nomask = _apply_moe_ffn(layer0, old, x, None, True)
+    np.testing.assert_allclose(float(aux_old), float(aux_old_nomask), atol=1e-7)
+    assert abs(float(aux_old) - float(aux_pad)) > 1e-7
+
+    # an all-pad row drops out of the batch mean instead of contributing a
+    # spurious zero: aux over [row0, all-pad row] equals aux over [row0]
+    two = jnp.stack([x[0], x[1]])
+    mask_allpad = jnp.stack(
+        [jnp.zeros((SEQ,), bool), jnp.ones((SEQ,), bool)]
+    )
+    _, aux_with_dead = _apply_moe_ffn(layer0, cfg, two, None, True, pad_mask=mask_allpad)
+    _, aux_alone = _apply_moe_ffn(
+        layer0, cfg, x[:1], None, True, pad_mask=jnp.zeros((1, SEQ), bool)
+    )
+    np.testing.assert_allclose(float(aux_with_dead), float(aux_alone), atol=1e-6)
+
+    # the masked aux still trains the router end to end through fit's
+    # objective (the gradient path survives the einsum rewrite)
+    model_batch = {
+        "input_ids": np.asarray(rng.randint(3, cfg.vocab_size, size=(4, SEQ)), np.int32),
+        "position_ids": np.ascontiguousarray(
+            np.broadcast_to(np.arange(SEQ, dtype=np.int32), (4, SEQ))
+        ),
+        "mask": np.asarray(np.arange(SEQ) >= real)[None].repeat(4, 0),
+    }
+    targets = np.roll(model_batch["input_ids"], -1, axis=1).astype(np.int32)
+    targets[model_batch["mask"]] = -100
+    loss, grads = SingleDevice().value_and_grad(params, cfg, model_batch, targets)
+    assert np.isfinite(float(loss))
+    assert float(jnp.max(jnp.abs(grads["layers"]["ffn"]["router"]["kernel"]))) > 0.0
+
+
 def test_moe_generation_batched_matches_serial(cfg):
     """Row-independent dispatch keeps the batched decode token-for-token
     equal to the serial one for MoE models too."""

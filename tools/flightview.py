@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Render a tpukit diagnostics bundle into a human-readable post-mortem.
+
+The hang watchdog / sentinel path (tpukit/obs/watchdog.py) dumps one JSON
+bundle per event into `--debug_dir`: every Python thread's stack, the
+flight-recorder ring (the loop's last-N records), live HBM gauges, the
+heartbeat snapshot across processes, in-flight async-checkpoint/prefetch
+state, and the run config. This tool turns that JSON into the page an
+operator actually reads at 3am: what fired, what every thread was doing,
+what the trainer did in the minutes before, and which process looks wrong.
+
+Like tools/report.py it needs NOTHING but the file — no jax import — so it
+runs anywhere the bundle was copied to.
+
+Usage:
+  python tools/flightview.py debug/bundle-step*-hang-*.json
+  python tools/flightview.py debug/            # newest bundle in the dir
+  python tools/flightview.py bundle.json --ring 50 --full-stacks
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+
+def resolve_bundle(path: str) -> Path:
+    """A file renders itself; a directory renders its newest bundle."""
+    p = Path(path)
+    if p.is_dir():
+        bundles = sorted(p.glob("bundle-*.json"))
+        if not bundles:
+            raise FileNotFoundError(f"{p}: no bundle-*.json files")
+        return bundles[-1]
+    return p
+
+
+def _ts(t) -> str:
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(float(t)))
+    except (TypeError, ValueError):
+        return str(t)
+
+
+def _interesting(stack: list[str]) -> list[str]:
+    """Trim a thread stack to the frames an operator reads first: drop the
+    interpreter/threading boilerplate prefix, keep everything from the
+    first non-runtime frame down (the blocked call is the LAST line)."""
+    boring = ("/threading.py", "/concurrent/", "bootstrap")
+    start = 0
+    for idx, line in enumerate(stack):
+        if line.strip().startswith("File") and not any(b in line for b in boring):
+            start = idx
+            break
+    return stack[start:]
+
+
+def render(bundle: dict, ring_tail: int = 25, full_stacks: bool = False) -> str:
+    out: list[str] = []
+    w = out.append
+
+    w("== diagnostics bundle ==")
+    w(f"  reason: {bundle.get('reason', '?')}   step: {bundle.get('step', '?')}"
+      f"   at {_ts(bundle.get('time'))}")
+    proc = bundle.get("process") or {}
+    if proc and "error" not in proc:
+        w(f"  process {proc.get('index', '?')}/{proc.get('count', '?')}   "
+          f"device: {proc.get('device_kind', '?')}   jax {proc.get('jax', '?')}")
+    if bundle.get("stuck_for_s") is not None:
+        w(f"  stuck for: {bundle['stuck_for_s']}s past the deadline")
+
+    inflight = bundle.get("inflight") or {}
+    if inflight:
+        w("== in-flight state ==")
+        for k, v in inflight.items():
+            w(f"  {k}: {v}")
+
+    mem = bundle.get("memory")
+    if isinstance(mem, dict) and "error" not in mem:
+        w("== device memory ==")
+        for k, v in mem.items():
+            w(f"  {k}: {v:,}" if isinstance(v, int) else f"  {k}: {v}")
+
+    beats = bundle.get("heartbeats")
+    if isinstance(beats, dict) and "error" not in beats:
+        w("== heartbeats ==")
+        now = bundle.get("time")
+        for k in sorted(beats, key=lambda x: int(x) if str(x).isdigit() else 0):
+            rec = beats[k]
+            age = ""
+            if now is not None and isinstance(rec, dict) and "time" in rec:
+                age = f"   age {now - rec['time']:.1f}s"
+            step = rec.get("step", "?") if isinstance(rec, dict) else "?"
+            cs = (
+                f"   checksum {rec['checksum']} @ step {rec.get('checksum_step', '?')}"
+                if isinstance(rec, dict) and rec.get("checksum")
+                else ""
+            )
+            w(f"  p{k}: step {step}{age}{cs}")
+
+    for key in ("stragglers", "mismatches"):
+        if bundle.get(key):
+            w(f"== {key} ==")
+            for item in bundle[key]:
+                w(f"  {item}")
+
+    stacks = bundle.get("stacks") or {}
+    if stacks:
+        w(f"== thread stacks ({len(stacks)}) ==")
+        # MainThread first: that is the (possibly hung) training thread
+        order = sorted(stacks, key=lambda n: (not n.startswith("MainThread"), n))
+        for name in order:
+            frames = stacks[name]
+            if not full_stacks:
+                frames = _interesting(frames)
+            w(f"  -- {name} --")
+            for line in frames:
+                for sub in line.splitlines():
+                    w(f"    {sub}")
+
+    ring = bundle.get("ring")
+    if ring is not None:
+        total = bundle.get("ring_total_recorded", len(ring))
+        tail = ring[-ring_tail:]
+        first = total - len(ring) + (len(ring) - len(tail)) + 1
+        w(f"== flight recorder (last {len(tail)} of {total} records) ==")
+        for idx, rec in enumerate(tail):
+            fields = " ".join(
+                f"{k}={v}" for k, v in rec.items() if k not in ("t", "kind")
+            )
+            w(f"  [{first + idx:>6}] {_ts(rec.get('t'))}  "
+              f"{rec.get('kind', '?'):<18} {fields}")
+
+    cfg = bundle.get("config")
+    if cfg:
+        w("== run config (non-default flags are the interesting ones) ==")
+        w("  " + "  ".join(f"{k}={v}" for k, v in sorted(cfg.items())))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "bundle", help="bundle JSON (or a --debug_dir: newest bundle wins)"
+    )
+    ap.add_argument(
+        "--ring", type=int, default=25, metavar="N",
+        help="how many trailing flight-recorder records to show (default 25)",
+    )
+    ap.add_argument(
+        "--full-stacks", action="store_true",
+        help="show every stack frame incl. interpreter/threading boilerplate",
+    )
+    args = ap.parse_args(argv)
+    try:
+        path = resolve_bundle(args.bundle)
+    except FileNotFoundError as exc:
+        print(exc, file=sys.stderr)
+        return 1
+    try:
+        bundle = json.loads(path.read_text())
+    except OSError as exc:
+        print(exc, file=sys.stderr)
+        return 1
+    except ValueError as exc:
+        print(f"{path}: not a JSON bundle ({exc})", file=sys.stderr)
+        return 1
+    try:
+        print(f"[{path}]")
+        print(render(bundle, ring_tail=args.ring, full_stacks=args.full_stacks))
+    except BrokenPipeError:  # `flightview ... | head` closed the pipe
+        sys.stderr.close()  # suppress the interpreter's EPIPE complaint
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

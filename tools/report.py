@@ -10,8 +10,11 @@ per-collective comm bytes), "validation"/"epoch" per-epoch records,
 "spike"/"straggler" sentinel events, and "compile_cache" hit/miss counts.
 Train windows from a prefetching run additionally carry
 `prefetch_stall_s`/`prefetch_occupancy` (round-7 host overlap), rendered
-in the training section. This tool needs NOTHING but the file — no jax
-import, so it runs anywhere the log was copied to.
+in the training section. Round-8 failure observability adds "watchdog"
+(hang/bundle events — bundles themselves render via tools/flightview.py),
+"divergence"/"divergence_check" (cross-replica checksums), and
+"anomaly_trace" (trace-on-anomaly lifecycle). This tool needs NOTHING but
+the file — no jax import, so it runs anywhere the log was copied to.
 
 Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
 """
@@ -196,6 +199,47 @@ def summarize(records: list[dict]) -> str:
         w("== stragglers ==")
         for r in stragglers:
             w(f"  step {r.get('step', '?')}: {r.get('stragglers')}")
+    # round-8 failure observability: hang-watchdog events, cross-replica
+    # divergence, anomaly-trace lifecycle
+    watchdog = _rows(records, "watchdog")
+    if watchdog:
+        w("== watchdog ==")
+        for r in watchdog:
+            if r.get("event") == "hang":
+                w(f"  HANG surfaced at step {r.get('step', '?')} "
+                  f"(total {r.get('hangs', '?')}); bundles: "
+                  + ", ".join(r.get("bundles") or []))
+            else:
+                w(f"  bundle [{r.get('reason', '?')}] step {r.get('step', '?')}: "
+                  f"{r.get('bundle', '?')}")
+        w("  (render a bundle: python tools/flightview.py <bundle.json>)")
+    divergence = _rows(records, "divergence")
+    if divergence:
+        w("== DIVERGENCE ==")
+        for r in divergence:
+            for m in r.get("mismatches") or []:
+                w(f"  step {m.get('checksum_step', '?')}: process "
+                  f"{m.get('process', '?')} checksum {m.get('checksum')} "
+                  f"!= majority {m.get('expected')}")
+    div_checks = _rows(records, "divergence_check")
+    if div_checks:
+        last = div_checks[-1]
+        w("== divergence checks ==")
+        w(f"  {len(div_checks)} checks"
+          + (", no mismatches" if not divergence else "")
+          + f"; last: step {last.get('step', '?')} "
+          f"checksum {last.get('checksum')}")
+    traces = _rows(records, "anomaly_trace")
+    if traces:
+        w("== anomaly trace ==")
+        for r in traces:
+            ev = r.get("event", "?")
+            line = f"  {ev} at step {r.get('step', '?')}"
+            if ev == "armed":
+                line += f" (reason: {r.get('reason', '?')})"
+            if r.get("dir"):
+                line += f" -> {r['dir']}"
+            w(line)
     cache_rows = _rows(records, "compile_cache")
     if cache_rows:
         w("== compile cache ==")

@@ -83,6 +83,36 @@ class TrainFlags:
     # process 0 reports processes whose beats go stale past the timeout.
     heartbeat_dir: str = ""
     heartbeat_timeout: float = 120.0  # seconds
+    # Failure observability (round 8, tpukit/obs). The flight recorder (a
+    # bounded in-memory ring of recent step/window/sentinel records) is
+    # ALWAYS on; these flags control what gets done with it when a run
+    # goes wrong:
+    #   --hang_timeout S > 0 starts the hang watchdog: a monitor thread
+    #     armed around each step iteration that dumps a diagnostics bundle
+    #     (all-thread stacks, recorder ring, HBM gauges, heartbeat
+    #     snapshot, in-flight async-checkpoint/prefetch state, run config)
+    #     to --debug_dir when an iteration overruns S seconds. The first
+    #     step of each compiled function is exempt (compile time is not a
+    #     hang); S bounds the steady-state step, not the compile.
+    #   --debug_dir D is where bundles (and the anomaly trace) land; any
+    #     sentinel firing (spike/NaN/straggler/divergence) also dumps a
+    #     bundle there. Defaults to "debug" when a feature needing it is
+    #     on; render bundles with tools/flightview.py.
+    hang_timeout: float = 0.0  # seconds; 0 disables the watchdog
+    debug_dir: str = ""
+    # Trace-on-anomaly: K > 0 arms a jax.profiler capture of the K steps
+    # following the FIRST anomaly of the run (spike/NaN/straggler/
+    # divergence/hang-recovery), so the expensive trace is collected
+    # exactly when it matters. Traces land under --debug_dir/anomaly_trace.
+    # Ignored when --profile_dir already traces the whole run.
+    trace_on_anomaly: int = 0
+    # Cross-replica divergence detection: every N steps compute an in-jit
+    # XOR checksum of params + opt state (a separate jitted program — the
+    # train step's HLO is byte-identical on/off, the --log_grad_norms
+    # discipline), publish it through the heartbeat file, and have
+    # process 0 compare across processes; a mismatch at the same step
+    # logs kind="divergence" and dumps a bundle. 0 disables.
+    divergence_check_freq: int = 0
     # Rematerialization policy: checkpoint each decoder layer (backward
     # recomputes the layer forward; less HBM traffic and memory — needed for
     # the larger ladder configs at long sequence).
@@ -179,6 +209,15 @@ def build_parser(
     parser.add_argument("--heartbeat_dir", type=str, default=defaults.heartbeat_dir)
     parser.add_argument(
         "--heartbeat_timeout", type=float, default=defaults.heartbeat_timeout
+    )
+    parser.add_argument("--hang_timeout", type=float, default=defaults.hang_timeout)
+    parser.add_argument("--debug_dir", type=str, default=defaults.debug_dir)
+    parser.add_argument(
+        "--trace_on_anomaly", type=int, default=defaults.trace_on_anomaly
+    )
+    parser.add_argument(
+        "--divergence_check_freq", type=int,
+        default=defaults.divergence_check_freq,
     )
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--scan_layers", action="store_true")

@@ -121,6 +121,15 @@ class GPTConfig:
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Compute the load-balance statistics over REAL tokens only (the Switch
+    # paper's convention), excluding pad positions from frac_tokens /
+    # mean_prob and normalizing by each row's real-token count (ADVICE r5
+    # #2). False restores the previous behavior — statistics averaged over
+    # every position including pads — for comparing against pre-round-8
+    # training curves. Only the aux-loss VALUE changes; routing, dispatch,
+    # and the FFN outputs are identical either way, and unpadded batches
+    # produce the same aux under both settings.
+    moe_aux_mask_pads: bool = True
     # routed experts per token: 1 = Switch (default), 2 = GShard/Mixtral-
     # style top-2. Gates stay the RAW router probabilities (GShard
     # convention) so top_k=1 is bit-identical to the Switch path.
@@ -259,9 +268,18 @@ def _apply_feed_forward(layer, cfg: GPTConfig, x, rng, deterministic):
     return dropout(h, cfg.dropout, rng, deterministic)
 
 
-def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic):
+def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic, pad_mask=None):
     """Routed mixture-of-experts FFN: Switch-style top-1 by default,
     GShard/Mixtral-style top-k via cfg.router_top_k. Returns (out, aux).
+
+    `pad_mask` (optional `[B, S]` bool, True = padding — the attention
+    convention) only affects the load-balance STATISTICS: with
+    cfg.moe_aux_mask_pads (default) pad positions are excluded from
+    frac_tokens/mean_prob and each row normalizes by its real-token count,
+    so heavily padded batches no longer dilute the balance signal toward
+    how pads route (ADVICE r5 #2). Dispatch itself still routes every
+    position — masking dispatch would change the FFN outputs and break
+    the width-invariance contract below.
 
     TPU-first design: STATIC shapes throughout — tokens dispatch into a
     fixed `[E, B, capacity, dim]` buffer via one-hot einsums, each expert
@@ -349,17 +367,29 @@ def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic):
 
     # Switch load-balance terms; /top_k keeps frac_tokens a distribution
     # (each token contributes k assignments).
-    # Documented deviation from Switch (ADVICE r5 #2): these statistics
-    # average over EVERY sequence position, including pad positions (the
-    # paper computes them over real tokens only), so heavily padded batches
-    # dilute the balance signal toward how pads route. Gradient flow to
-    # real-token CE is unaffected — the aux loss is a regularizer — and the
-    # fixture/TinyStories batches are near-full, so the skew is accepted
-    # for the same reason as the other twin quirks in this file. Masking
-    # would need the pad mask threaded into every FFN call site.
-    frac_tokens = jnp.mean(assign, axis=1) / top_k  # [B, E]
-    mean_prob = jnp.mean(probs, axis=1)  # [B, E]
-    aux = n_exp * jnp.mean(jnp.sum(frac_tokens * mean_prob, axis=-1))
+    if pad_mask is not None and cfg.moe_aux_mask_pads:
+        # Switch convention (ADVICE r5 #2): statistics over REAL tokens
+        # only. Per-row normalization by the real-token count, and all-pad
+        # rows drop out of the batch mean entirely (their clamped
+        # denominator would otherwise contribute a spurious zero).
+        real = (~pad_mask).astype(jnp.float32)  # [B, S]
+        count = jnp.maximum(jnp.sum(real, axis=1), 1.0)  # [B]
+        frac_tokens = (
+            jnp.einsum("bse,bs->be", assign, real) / count[:, None] / top_k
+        )
+        mean_prob = jnp.einsum("bse,bs->be", probs, real) / count[:, None]
+        row_real = (jnp.sum(real, axis=1) > 0).astype(jnp.float32)  # [B]
+        aux = n_exp * jnp.sum(
+            jnp.sum(frac_tokens * mean_prob, axis=-1) * row_real
+        ) / jnp.maximum(jnp.sum(row_real), 1.0)
+    else:
+        # Pre-round-8 behavior (cfg.moe_aux_mask_pads=False, or call sites
+        # without a mask — the cached decode path): average over every
+        # position including pads. Kept selectable so pre-masking training
+        # curves stay reproducible.
+        frac_tokens = jnp.mean(assign, axis=1) / top_k  # [B, E]
+        mean_prob = jnp.mean(probs, axis=1)  # [B, E]
+        aux = n_exp * jnp.mean(jnp.sum(frac_tokens * mean_prob, axis=-1))
     return dropout(out, cfg.dropout, rng, deterministic), aux
 
 
@@ -412,7 +442,9 @@ def apply_decoder_layer(layer: Params, cfg: GPTConfig, x, pad_mask, rng=None, de
     x = x + _apply_attention(layer, cfg, h, pad_mask, attn_rng, deterministic)
     h = layer_norm(x, layer["norm2"]).astype(cfg.compute_dtype)
     if cfg.num_experts > 0:
-        ffn_out, aux = _apply_moe_ffn(layer, cfg, h, ffn_rng, deterministic)
+        ffn_out, aux = _apply_moe_ffn(
+            layer, cfg, h, ffn_rng, deterministic, pad_mask=pad_mask
+        )
         return x + ffn_out, aux
     x = x + _apply_feed_forward(layer, cfg, h, ffn_rng, deterministic)
     return x

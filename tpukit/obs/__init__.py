@@ -1,25 +1,38 @@
 """tpukit.obs — the telemetry subsystem.
 
-Supersedes the old flat `tpukit/profiling.py` (now a compat shim). Four
+Supersedes the old flat `tpukit/profiling.py` (now a compat shim). The
 pillars, one per module:
 
-  - `meter`     — MFUMeter (tokens/sec, MFU), `trace`, JSONL `StepLogger`.
-  - `spans`     — `SpanTimeline`: host-phase wall-clock accounting and the
-                  goodput breakdown (fraction of time inside the compiled
-                  step vs data wait / H2D / checkpoint / eval).
-  - `xla`       — static analysis of compiled steps: `cost_analysis` FLOPs
-                  and bytes, `memory_analysis` peak HBM, per-collective
-                  comm bytes parsed from the optimized HLO, plus live
-                  `device.memory_stats()` gauges.
-  - `sentinels` — in-jit global grad/update/param norms and the host-side
-                  loss-spike/NaN `SpikeSentinel`.
-  - `heartbeat` — per-process liveness files + process-0 straggler check
-                  for multi-host runs.
+  - `meter`      — MFUMeter (tokens/sec, MFU), `trace`, JSONL `StepLogger`.
+  - `spans`      — `SpanTimeline`: host-phase wall-clock accounting and the
+                   goodput breakdown (fraction of time inside the compiled
+                   step vs data wait / H2D / checkpoint / eval).
+  - `xla`        — static analysis of compiled steps: `cost_analysis` FLOPs
+                   and bytes, `memory_analysis` peak HBM, per-collective
+                   comm bytes parsed from the optimized HLO, plus live
+                   `device.memory_stats()` gauges.
+  - `sentinels`  — in-jit global grad/update/param norms and the host-side
+                   loss-spike/NaN `SpikeSentinel`.
+  - `heartbeat`  — per-process liveness files + process-0 straggler and
+                   cross-replica divergence checks for multi-host runs.
+  - `recorder`   — `FlightRecorder`: always-on bounded ring of the loop's
+                   recent history, serialized into diagnostics bundles.
+  - `watchdog`   — `HangWatchdog` (hung-step deadline monitor + bundle
+                   dumps), `AnomalyTracer` (trace-on-anomaly profiler
+                   capture), `write_bundle`/`all_thread_stacks`.
+  - `divergence` — periodic in-jit param/opt-state checksums compared
+                   across data-parallel replicas via the heartbeat files.
 
-The trainer (`tpukit/train.py`) wires all four through `fit()`;
-`tools/report.py` renders a run's JSONL into a human-readable summary.
+The trainer (`tpukit/train.py`) wires all of it through `fit()`;
+`tools/report.py` renders a run's JSONL and `tools/flightview.py` renders
+a diagnostics bundle into a human-readable post-mortem.
 """
 
+from tpukit.obs.divergence import (  # noqa: F401
+    format_checksum,
+    make_state_checksum,
+    tree_checksum,
+)
 from tpukit.obs.heartbeat import Heartbeat  # noqa: F401
 from tpukit.obs.meter import (  # noqa: F401
     MFUMeter,
@@ -29,8 +42,15 @@ from tpukit.obs.meter import (  # noqa: F401
     trace,
     train_flops_per_token,
 )
+from tpukit.obs.recorder import FlightRecorder  # noqa: F401
 from tpukit.obs.sentinels import SpikeEvent, SpikeSentinel, global_norms  # noqa: F401
 from tpukit.obs.spans import GOODPUT_SPANS, SpanTimeline, format_breakdown  # noqa: F401
+from tpukit.obs.watchdog import (  # noqa: F401
+    AnomalyTracer,
+    HangWatchdog,
+    all_thread_stacks,
+    write_bundle,
+)
 from tpukit.obs.xla import (  # noqa: F401
     COLLECTIVE_OPS,
     collective_bytes,

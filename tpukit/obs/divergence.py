@@ -1,0 +1,86 @@
+"""Cross-replica divergence detection: in-jit state checksums.
+
+Silent divergence — data-parallel replicas drifting apart through a bad
+host, a flaky DIMM, or a non-deterministic collective — is the failure the
+fault-tolerance literature calls the most expensive to find (La Malfa et
+al., PAPERS.md): the loss keeps printing, every heartbeat stays fresh, and
+the model quietly trains to garbage. The detector here is a periodic
+checksum of the params + optimizer state computed INSIDE a jitted program
+(`--divergence_check_freq N` steps), published through each process's
+heartbeat file, and compared across processes by process 0. Replicas that
+should be bit-identical (DP keeps the state replicated; SPMD lockstep
+keeps every process's copy equal) must produce the same checksum at the
+same step — one flipped bit anywhere in params or Adam moments changes it.
+
+Checksum design:
+
+  - XOR-fold of the raw bit patterns (`lax.bitcast_convert_type` to u32),
+    not a float sum: order-independent (so resharding/layout cannot change
+    it), exact (no cancellation — a 1-ulp perturbation of one element
+    flips it), and cheap (one pass, no transcendentals).
+  - Per-leaf folds combine through a multiply-xor hash so two leaves
+    swapping identical corruption cannot cancel each other out.
+  - It is a SEPARATE jitted program, not a branch of the train step: the
+    compiled train-step HLO is byte-identical whether the flag is on or
+    off (the `--log_grad_norms` discipline, tests/test_flightrec.py), and
+    the cost is paid only on check steps.
+
+Scope (documented honestly): for replicated state (SingleDevice/DP) the
+fold is process-local math on the local replica, so per-process checksums
+are INDEPENDENT measurements and a mismatch localizes the diverged host.
+For cross-host *sharded* state (FSDP/TP/pipeline) the fold's reduction is
+a collective, so every process reports the same global value — it still
+changes on any corruption (a run-integrity stamp, useful for comparing
+against a restarted run) but cannot name the bad host.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _leaf_fold(leaf) -> jax.Array:
+    """u32 XOR-fold of one array's bit pattern. Floats go through f32 so
+    bf16/f32 params hash identically to their checkpointed f32 master
+    values; bools/ints widen to u32 (deterministic, sign-wrapped)."""
+    x = leaf
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        x = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    else:
+        x = x.astype(jnp.uint32)
+    x = x.reshape(-1)
+    return jax.lax.reduce(x, jnp.uint32(0), jax.lax.bitwise_xor, (0,))
+
+
+def tree_checksum(tree) -> jax.Array:
+    """u32 checksum of a pytree. Leaf folds are combined positionally with
+    a multiply-xor hash (FNV-style) so identical corruption in two
+    different leaves cannot cancel."""
+    acc = jnp.uint32(2166136261)  # FNV offset basis
+    for leaf in jax.tree_util.tree_leaves(tree):
+        acc = (acc * jnp.uint32(16777619)) ^ _leaf_fold(leaf)
+    return acc
+
+
+def make_state_checksum():
+    """Jitted `state -> {"params": u32, "opt_state": u32}`. One compile per
+    state structure; call it every `--divergence_check_freq` steps. The
+    result is replicated, so `device_get` is process-local."""
+
+    @jax.jit
+    def checksum(state):
+        return {
+            "params": tree_checksum(state.params),
+            "opt_state": tree_checksum(state.opt_state),
+        }
+
+    return checksum
+
+
+def format_checksum(ck: dict) -> str:
+    """Host-side rendering of a checksum dict: `params:opt_state` hex —
+    the string the heartbeat file and JSONL records carry."""
+    p = int(jax.device_get(ck["params"]))
+    o = int(jax.device_get(ck["opt_state"]))
+    return f"{p:08x}:{o:08x}"

@@ -16,6 +16,11 @@ the directory) reads to see WHICH host stopped advancing and at what step,
 instead of staring at N identical frozen consoles.
 
 Writes are atomic (tmp + rename) so a reader never sees a torn JSON file.
+
+Round 8: the beat record optionally carries the divergence checksum
+(obs/divergence.py) — `checksum` + `checksum_step` — and process 0's
+`check_divergence()` compares checksums across processes at the same
+step, turning silent cross-replica drift into a named process.
 """
 
 from __future__ import annotations
@@ -60,22 +65,35 @@ class Heartbeat:
         self._last_beat: float | None = None
         self._cadence: float | None = None  # observed seconds between beats
 
-    def beat(self, step: int, now: float | None = None) -> None:
-        """Write this process's liveness record (atomic replace)."""
+    def beat(
+        self,
+        step: int,
+        now: float | None = None,
+        checksum: str | None = None,
+        checksum_step: int | None = None,
+    ) -> None:
+        """Write this process's liveness record (atomic replace).
+
+        `checksum`/`checksum_step` (divergence detection, obs/divergence.py)
+        piggyback the latest state checksum on the existing liveness file so
+        the cross-process comparison needs no new rendezvous: process 0
+        already reads every beat each window."""
         now = time.time() if now is None else now
         if self._last_beat is not None:
             self._cadence = now - self._last_beat
         self._last_beat = now
-        tmp = self.path.with_suffix(".tmp")
-        tmp.write_text(
-            json.dumps(
-                {
-                    "process": self.process_index,
-                    "step": int(step),
-                    "time": now,
-                }
+        rec = {
+            "process": self.process_index,
+            "step": int(step),
+            "time": now,
+        }
+        if checksum is not None:
+            rec["checksum"] = checksum
+            rec["checksum_step"] = int(
+                checksum_step if checksum_step is not None else step
             )
-        )
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(rec))
         os.replace(tmp, self.path)
 
     def read_all(self) -> dict[int, dict]:
@@ -126,4 +144,39 @@ class Heartbeat:
                      "step": rec.get("step"),
                      "behind": max_step - rec.get("step", 0)}
                 )
+        return out
+
+    def check_divergence(self) -> list[dict]:
+        """Cross-replica checksum comparison (run on process 0 each window).
+
+        Groups the beat files' `checksum` values by `checksum_step` and
+        compares only beats taken at the SAME step — processes mid-window
+        skew (one already past the next check step) are simply not compared
+        yet, so skew can never produce a false positive. At any step where
+        more than one distinct checksum exists, the minority processes are
+        reported against the majority value (ties break deterministically
+        by checksum string). Returns one record per diverged process:
+        `{process, checksum_step, checksum, expected}`.
+        """
+        by_step: dict[int, dict[str, list[int]]] = {}
+        for rec in self.read_all().values():
+            cs, st = rec.get("checksum"), rec.get("checksum_step")
+            if cs is None or st is None:
+                continue
+            by_step.setdefault(int(st), {}).setdefault(str(cs), []).append(
+                int(rec["process"])
+            )
+        out = []
+        for st in sorted(by_step):
+            groups = by_step[st]
+            if len(groups) < 2:
+                continue
+            ranked = sorted(groups.items(), key=lambda kv: (-len(kv[1]), kv[0]))
+            majority = ranked[0][0]
+            for cs, procs in ranked[1:]:
+                for proc in sorted(procs):
+                    out.append(
+                        {"process": proc, "checksum_step": st,
+                         "checksum": cs, "expected": majority}
+                    )
         return out

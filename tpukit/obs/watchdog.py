@@ -1,0 +1,356 @@
+"""Hang watchdog, diagnostics bundles, and trace-on-anomaly.
+
+The worst multi-host failure mode is the silent hang: one process wedges
+inside a collective and every other process blocks with it, so the
+operator gets a stalled tqdm bar and N frozen consoles (SURVEY §3.5 —
+exactly the reference's rank-0 FSDP generate hang). The heartbeat files
+(obs/heartbeat.py) say WHICH host stopped; this module says WHAT it was
+doing when it stopped:
+
+  - `HangWatchdog`: a daemon monitor thread armed/disarmed around each
+    step of the training loop. When an armed step overruns its deadline
+    (`--hang_timeout`), or when any sentinel asks for it explicitly
+    (`trigger()` — loss spike, NaN, heartbeat straggler, cross-replica
+    divergence), it dumps a **diagnostics bundle**: one JSON file with
+    every Python thread's stack (`sys._current_frames` — the training
+    thread's frame shows which call is blocked), the flight-recorder ring
+    (obs/recorder.py — what the loop did in the minutes before), live
+    `device.memory_stats()` gauges, the heartbeat snapshot across
+    processes, the in-flight async-checkpoint/prefetcher state, and the
+    run config. The dump is pure host work (stack walk + file write) so
+    it succeeds even while every device queue is wedged — which is the
+    entire point.
+  - `AnomalyTracer`: the first anomaly of a run arms a `jax.profiler`
+    capture of the next K steps, so the expensive trace exists exactly
+    for the steps that matter instead of being always-on (Megatron-style
+    production runs treat this as the difference between a 5-minute and
+    a 5-hour debug, PAPERS.md). It arms ONCE per run: anomalies tend to
+    repeat, and a trace per spike would bury the signal.
+
+Bundle writes are atomic (tmp + rename, the heartbeat discipline) and the
+dump count is bounded (`max_dumps`) so a flapping sentinel cannot fill the
+disk. Render a bundle with `python tools/flightview.py <bundle.json>`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from pathlib import Path
+from typing import Any, Callable
+
+
+def all_thread_stacks() -> dict[str, list[str]]:
+    """Formatted stack of every live Python thread, keyed by
+    `"{thread name}-{ident}"`. The GIL makes `sys._current_frames` a
+    consistent point-in-time snapshot; frames of threads blocked in C
+    extensions (a wedged collective, a queue.get) show the last Python
+    line — which is the diagnosis."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        label = f"{names.get(ident, 'unknown')}-{ident}"
+        out[label] = [l.rstrip("\n") for l in traceback.format_stack(frame)]
+    return out
+
+
+def _jsonable(obj):
+    """Best-effort JSON coercion: a bundle written during a failure must
+    never itself fail on an exotic value."""
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+def write_bundle(
+    directory: str | os.PathLike,
+    reason: str,
+    step: int | None = None,
+    recorder=None,
+    heartbeat=None,
+    probes: dict[str, Callable[[], Any]] | None = None,
+    config=None,
+    extra: dict | None = None,
+) -> Path:
+    """Assemble and atomically write one diagnostics bundle; returns its
+    path. Every section is best-effort: a probe that raises lands as its
+    error string, never aborts the dump."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+
+    bundle: dict[str, Any] = {
+        "reason": reason,
+        "step": step,
+        "time": time.time(),
+        "stacks": all_thread_stacks(),
+    }
+    try:
+        import jax
+
+        bundle["process"] = {
+            "index": jax.process_index(),
+            "count": jax.process_count(),
+            "device_kind": jax.devices()[0].device_kind,
+            "jax": jax.__version__,
+        }
+    except Exception as exc:  # pre-init or wedged backend: still dump
+        bundle["process"] = {"error": repr(exc)}
+    if recorder is not None:
+        bundle["ring"] = [
+            {k: _jsonable(v) for k, v in r.items()} for r in recorder.snapshot()
+        ]
+        bundle["ring_total_recorded"] = recorder.total_recorded
+    if heartbeat is not None:
+        try:
+            bundle["heartbeats"] = {
+                str(k): v for k, v in heartbeat.read_all().items()
+            }
+        except Exception as exc:
+            bundle["heartbeats"] = {"error": repr(exc)}
+    try:
+        from tpukit.obs.xla import live_memory_stats
+
+        bundle["memory"] = live_memory_stats()
+    except Exception as exc:
+        bundle["memory"] = {"error": repr(exc)}
+    if probes:
+        inflight = {}
+        for name, fn in probes.items():
+            try:
+                inflight[name] = _jsonable(fn())
+            except Exception as exc:
+                inflight[name] = repr(exc)
+        bundle["inflight"] = inflight
+    if config is not None:
+        if dataclasses.is_dataclass(config) and not isinstance(config, type):
+            config = dataclasses.asdict(config)
+        bundle["config"] = {str(k): _jsonable(v) for k, v in dict(config).items()}
+    if extra:
+        bundle.update({k: _jsonable(v) for k, v in extra.items()})
+
+    # one file per dump; the PROCESS INDEX is part of the name because a
+    # pod-wide hang makes every process dump the same step at the same
+    # millisecond into the same shared --debug_dir — step+reason+ms alone
+    # would collide (and os.replace would silently drop all but one)
+    proc = bundle.get("process", {}).get("index", 0) or 0
+    stamp = f"{int(time.time() * 1000):013d}"
+    name = (
+        f"bundle-step{step if step is not None else 0:08d}-{reason}"
+        f"-p{proc:05d}-{stamp}.json"
+    )
+    path = directory / name
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(bundle, indent=1, default=repr))
+    os.replace(tmp, path)
+    return path
+
+
+class HangWatchdog:
+    """Deadline monitor around the training loop's step iterations.
+
+    `arm(step)` (re)sets a deadline `timeout_s` from now; `disarm()`
+    clears it. A daemon thread polls; an armed deadline that passes dumps
+    a `reason="hang"` bundle and clears itself (one bundle per overrun —
+    the next `arm` starts a fresh deadline). `trigger(reason)` dumps
+    synchronously from the calling thread — the sentinel/divergence path.
+    Both share the `max_dumps` budget.
+
+    The watchdog is advisory: it records, it does not kill. When the hang
+    is a wedged collective the training thread cannot be safely unwound
+    from another thread anyway; the bundle is the artifact the operator
+    (or the babysitter tailing `--debug_dir`) acts on.
+    """
+
+    def __init__(
+        self,
+        debug_dir: str | os.PathLike,
+        timeout_s: float = 0.0,
+        recorder=None,
+        heartbeat=None,
+        probes: dict[str, Callable[[], Any]] | None = None,
+        config=None,
+        max_dumps: int = 8,
+        poll_s: float | None = None,
+    ):
+        if timeout_s < 0:
+            raise ValueError(f"hang timeout must be >= 0, got {timeout_s}")
+        self.debug_dir = Path(debug_dir)
+        self.timeout_s = timeout_s
+        self.recorder = recorder
+        self.heartbeat = heartbeat
+        self.probes = probes or {}
+        self.config = config
+        self.max_dumps = max_dumps
+        self.dumps: list[Path] = []
+        # one entry per hang overrun, IN ORDER, with the bundle that dump
+        # produced (None once the budget is spent) — so the trainer can
+        # attribute each surfaced hang to ITS bundle instead of guessing
+        # from the shared `dumps` list, which trigger() bundles also feed
+        self.hang_events: list[dict] = []
+        self.hang_count = 0
+        self._deadline: float | None = None
+        self._armed_step: int | None = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if timeout_s > 0:
+            # poll a fraction of the timeout so "fires within hang_timeout"
+            # means within ~1.25x of it worst-case, bounded for huge timeouts
+            self._poll = poll_s if poll_s else max(0.02, min(timeout_s / 4.0, 1.0))
+            self._thread = threading.Thread(
+                target=self._monitor, daemon=True, name="tpukit-watchdog"
+            )
+            self._thread.start()
+
+    # -- training-thread surface ------------------------------------------
+
+    def arm(self, step: int) -> None:
+        """Start (or reset) the deadline for the iteration handling `step`."""
+        with self._lock:
+            self._deadline = time.monotonic() + self.timeout_s
+            self._armed_step = step
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._deadline = None
+            self._armed_step = None
+
+    def trigger(self, reason: str, step: int | None = None, **extra) -> Path | None:
+        """Synchronous bundle dump (sentinel / divergence path). Returns the
+        bundle path, or None once the dump budget is spent."""
+        return self._dump(reason, step=step, extra=extra)
+
+    def close(self) -> None:
+        self.disarm()
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    # -- monitor ----------------------------------------------------------
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self._poll):
+            with self._lock:
+                deadline, step = self._deadline, self._armed_step
+            if deadline is None or time.monotonic() < deadline:
+                continue
+            overdue = time.monotonic() - (deadline - self.timeout_s)
+            self.hang_count += 1
+            path = self._dump(
+                "hang", step=step, extra={"stuck_for_s": round(overdue, 3)}
+            )
+            self.hang_events.append(
+                {"step": step, "bundle": str(path) if path else None}
+            )
+            # one bundle per overrun: the stacks of a still-hung step would
+            # be identical; a recovered loop re-arms and re-covers itself
+            with self._lock:
+                if self._deadline == deadline:
+                    self._deadline = None
+
+    def _dump(self, reason: str, step: int | None, extra: dict | None) -> Path | None:
+        with self._lock:
+            if len(self.dumps) >= self.max_dumps:
+                return None
+        try:
+            path = write_bundle(
+                self.debug_dir, reason, step=step, recorder=self.recorder,
+                heartbeat=self.heartbeat, probes=self.probes,
+                config=self.config, extra=extra,
+            )
+        except Exception as exc:  # the watchdog must never kill the run
+            print(f"watchdog: bundle dump failed: {exc!r}", file=sys.stderr)
+            return None
+        with self._lock:
+            self.dumps.append(path)
+        print(f"watchdog: {reason} at step {step}; bundle {path}", file=sys.stderr)
+        return path
+
+
+class AnomalyTracer:
+    """Arm a jax.profiler capture of the next K steps at the FIRST anomaly.
+
+    State machine: idle -> (trigger) armed -> (maybe_start, training
+    thread) tracing -> (step x K) done. `trigger()` after the first call
+    is a no-op — one trace per run, collected exactly when it matters.
+    `maybe_start`/`step` MUST run on the training thread (jax.profiler is
+    not safe to start from the monitor thread); `trigger` may be called
+    from anywhere — it only flips a flag.
+    """
+
+    def __init__(self, trace_dir: str | os.PathLike, steps: int = 8):
+        if steps < 1:
+            raise ValueError(f"trace step count must be >= 1, got {steps}")
+        self.trace_dir = str(trace_dir)
+        self.steps = steps
+        self.reason: str | None = None
+        self._armed = threading.Event()
+        self._tracing = False
+        self._done = False
+        self._remaining = 0
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    @property
+    def tracing(self) -> bool:
+        return self._tracing
+
+    def trigger(self, reason: str = "anomaly") -> bool:
+        """First call arms the capture; later calls are no-ops. Returns
+        True when this call did the arming."""
+        if self._done or self._tracing or self._armed.is_set():
+            return False
+        self.reason = reason
+        self._armed.set()
+        return True
+
+    def maybe_start(self) -> bool:
+        """Call at the top of each step iteration (training thread): starts
+        the profiler when armed. Returns True when the trace started."""
+        if not self._armed.is_set() or self._tracing or self._done:
+            return False
+        import jax
+
+        try:
+            jax.profiler.start_trace(self.trace_dir)
+        except Exception as exc:  # another trace active, backend quirk
+            print(f"anomaly trace failed to start: {exc!r}", file=sys.stderr)
+            self._done = True  # don't retry every step
+            return False
+        self._tracing = True
+        self._remaining = self.steps
+        return True
+
+    def step(self) -> bool:
+        """Call once per completed step while tracing; stops the profiler
+        after K steps. Returns True when this call stopped the trace."""
+        if not self._tracing:
+            return False
+        self._remaining -= 1
+        if self._remaining > 0:
+            return False
+        return self.stop()
+
+    def stop(self) -> bool:
+        """Stop an active capture (also called by fit() on unwind so a
+        crashed run still flushes its partial trace)."""
+        if not self._tracing:
+            return False
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as exc:
+            print(f"anomaly trace failed to stop: {exc!r}", file=sys.stderr)
+        self._tracing = False
+        self._done = True
+        return True

@@ -127,6 +127,13 @@ class HostPrefetcher:
             raise val
         raise StopIteration
 
+    @property
+    def buffered(self) -> int:
+        """Batches currently ready in the buffer (non-resetting gauge —
+        the diagnostics-bundle probe; window_stats owns the per-window
+        occupancy average)."""
+        return self._queue.qsize()
+
     def window_stats(self) -> dict:
         """Mean buffer occupancy since the last call (the per-window JSONL
         gauge), then reset."""

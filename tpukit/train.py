@@ -57,6 +57,9 @@ from tpukit.prefetch import HostPrefetcher
 from tpukit.mesh import initialize_runtime, is_process_zero
 from tpukit.model import gpt
 from tpukit.obs import (
+    AnomalyTracer,
+    FlightRecorder,
+    HangWatchdog,
     Heartbeat,
     MFUMeter,
     SpanTimeline,
@@ -64,8 +67,10 @@ from tpukit.obs import (
     StepLogger,
     compiled_stats,
     format_breakdown,
+    format_checksum,
     global_norms,
     live_memory_stats,
+    make_state_checksum,
     trace,
 )
 from tpukit.sampling import generate_batch
@@ -328,6 +333,13 @@ def fit(
     p0 = is_process_zero()
     if flags.prefetch < 0:
         raise ValueError(f"--prefetch must be >= 0, got {flags.prefetch}")
+    if flags.hang_timeout < 0:
+        raise ValueError(f"--hang_timeout must be >= 0, got {flags.hang_timeout}")
+    if flags.divergence_check_freq < 0:
+        raise ValueError(
+            f"--divergence_check_freq must be >= 0, got "
+            f"{flags.divergence_check_freq}"
+        )
     # Persistent XLA compilation cache (round 7): repeat runs of the same
     # program skip recompiles; hits/misses are logged at the end of the run.
     cache_stats = (
@@ -479,6 +491,11 @@ def fit(
     logger = StepLogger(flags.metrics_log if p0 else "")
     # ---- telemetry (tpukit/obs, round 6) --------------------------------
     spans = SpanTimeline()
+    # Flight recorder (round 8): always on — a bounded ring of recent
+    # step/window/sentinel records, read only when a diagnostics bundle is
+    # dumped. The cost is one dict + deque append per step (<1% of any
+    # real step; bench.py's obs_overhead record audits it).
+    recorder = FlightRecorder()
     # Sentinel runs on EVERY process with identical inputs (the window loss
     # is a replicated global mean), so an "abort" decision is collective-
     # consistent — each process checkpoints and raises in lockstep instead
@@ -527,6 +544,118 @@ def fit(
     # possible resume, then pure host arithmetic) so periodic checkpointing
     # never forces a per-step `int(state.step)` sync inside the hot loop.
     host_step = int(state.step)
+
+    # ---- failure observability (round 8): watchdog + bundles + trace-on-
+    # anomaly + divergence checksums (docs/DESIGN.md "failure
+    # observability"). The watchdog exists whenever bundles can be asked
+    # for (--hang_timeout and/or --debug_dir); its monitor thread only
+    # runs with a positive timeout.
+    debug_dir = flags.debug_dir or (
+        "debug"
+        if flags.hang_timeout > 0
+        or flags.trace_on_anomaly > 0
+        or flags.divergence_check_freq > 0
+        else ""
+    )
+    # in-flight async state the bundle snapshots; the prefetcher slot is
+    # re-pointed each epoch
+    pf_live: dict[str, Any] = {"pf": None}
+
+    def _prefetch_probe():
+        pf = pf_live["pf"]
+        if pf is None:
+            return None
+        return {"depth": pf.depth, "buffered": pf.buffered}
+
+    watchdog = (
+        HangWatchdog(
+            debug_dir,
+            timeout_s=flags.hang_timeout,
+            recorder=recorder,
+            heartbeat=heart,
+            probes={
+                "host_step": lambda: host_step,
+                "async_checkpoint_in_flight": (
+                    lambda: async_saver.in_flight if async_saver else False
+                ),
+                "prefetcher": _prefetch_probe,
+            },
+            config=flags,
+        )
+        if debug_dir
+        else None
+    )
+    # Trace-on-anomaly: the FIRST anomaly arms a jax.profiler capture of
+    # the next K steps. Mutually exclusive with a whole-run --profile_dir
+    # trace (jax supports one active capture).
+    tracer = (
+        AnomalyTracer(
+            os.path.join(debug_dir, "anomaly_trace"), flags.trace_on_anomaly
+        )
+        if flags.trace_on_anomaly > 0 and debug_dir and not flags.profile_dir
+        else None
+    )
+    # Divergence checksums ride a SEPARATE jitted program so the train
+    # step's HLO is byte-identical with the flag off (tests assert it).
+    checksum_fn = (
+        make_state_checksum() if flags.divergence_check_freq > 0 else None
+    )
+    if checksum_fn is not None:
+        if jax.process_count() > 1 and heart is None:
+            # cross-replica comparison rides the heartbeat files; without
+            # them a multi-host run would pay for checksums that nothing
+            # ever compares — the exact silent failure this flag exists
+            # to catch. Fail loudly instead.
+            raise ValueError(
+                "--divergence_check_freq needs --heartbeat_dir on multi-"
+                "process runs: checksums are compared across processes "
+                "through the shared heartbeat files"
+            )
+        # Compile the checksum program NOW, before the watchdog ever arms:
+        # its one-off trace+compile at the first check step would otherwise
+        # run inside an armed iteration and a long compile could dump a
+        # spurious "hang" bundle (and burn the once-per-run anomaly trace).
+        jax.block_until_ready(checksum_fn(state)["params"])
+    last_checksum: tuple[int, str] | None = None  # (step, hex)
+    # (process, checksum_step, checksum) triples already reported: beats
+    # republish the same mismatch every window until the next check step,
+    # and one divergence must not spam the JSONL or drain the bundle budget
+    reported_divergence: set = set()
+    # Check-step dispatches are ASYNC (two u32 scalars in flight, the
+    # producing state released at dispatch); the D2H read happens at the
+    # window boundary, which syncs anyway — so a check step costs one
+    # extra jitted pass, never a mid-window pipeline stall.
+    pending_checks: list[tuple[int, dict]] = []
+    hangs_logged = 0
+
+    def flush_checks() -> None:
+        nonlocal last_checksum
+        for st, ck in pending_checks:
+            cs = format_checksum(ck)  # the deferred D2H read
+            last_checksum = (st, cs)
+            recorder.record("divergence_check", step=st, checksum=cs)
+            logger.log(kind="divergence_check", step=st, checksum=cs)
+        pending_checks.clear()
+
+    def note_anomaly(reason: str, step: int) -> None:
+        """First anomaly arms the trace; every anomaly lands in the ring."""
+        recorder.record("anomaly", reason=reason, step=step)
+        if tracer is not None and tracer.trigger(reason):
+            logger.log(
+                kind="anomaly_trace", event="armed", reason=reason, step=step
+            )
+
+    def dump_bundle(reason: str, step: int, **ctx):
+        if watchdog is None:
+            return None
+        path = watchdog.trigger(reason, step=step, **ctx)
+        if path is not None:
+            logger.log(
+                kind="watchdog", event="bundle", reason=reason, step=step,
+                bundle=str(path),
+            )
+        return path
+
     if heart is not None:
         heart.beat(host_step)  # liveness file exists before the first compile
 
@@ -537,11 +666,27 @@ def fit(
     maybe_nans = (
         _debug_nans_scope() if flags.debug_nans else contextlib.nullcontext()
     )
+    # First call of each compiled step function pays the jit compile —
+    # minutes at pod scale — so the watchdog only arms once the function
+    # is warm: --hang_timeout bounds the steady-state step, not the
+    # compile.
+    warm = {"train": False, "eval": False}
+
+    def _close_obs():
+        # runs on ANY exit of the training block (normal, spike abort,
+        # debug_nans, KeyboardInterrupt): flush a partial anomaly trace
+        # and stop the monitor thread before the final checkpoint I/O
+        if tracer is not None and tracer.stop():
+            logger.log(kind="anomaly_trace", event="stopped", step=host_step)
+        if watchdog is not None:
+            watchdog.close()
+
     # _cleanup: any exception unwinding the loop (debug_nans aborts, device
     # OOM, KeyboardInterrupt) must release the epoch's prefetch worker —
     # close() is idempotent, so registering each epoch's prefetcher is safe.
-    with maybe_nojit, maybe_nans, trace(flags.profile_dir), \
-            contextlib.ExitStack() as _cleanup:
+    with contextlib.ExitStack() as _obs_guard, maybe_nojit, maybe_nans, \
+            trace(flags.profile_dir), contextlib.ExitStack() as _cleanup:
+        _obs_guard.callback(_close_obs)
         for epoch in range(epochs):
             # ---- train ---------------------------------------------------
             train_loader.set_epoch(epoch)
@@ -578,12 +723,25 @@ def fit(
                 if flags.prefetch > 0
                 else None
             )
+            pf_live["pf"] = pf  # bundle probe sees this epoch's prefetcher
             if pf is not None:
                 _cleanup.callback(pf.close)
             _cleanup.callback(bar.close)
             it = iter(train_loader) if pf is None else None
             i = -1
             while True:
+                # The watchdog deadline covers the WHOLE iteration — input
+                # wait, dispatch, window sync, periodic checkpoint — so a
+                # hang in any of them trips it; re-arming each iteration
+                # resets the clock.
+                if watchdog is not None and warm["train"]:
+                    watchdog.arm(host_step + 1)
+                if tracer is not None and tracer.maybe_start():
+                    logger.log(
+                        kind="anomaly_trace", event="started",
+                        step=host_step + 1, reason=tracer.reason,
+                        dir=tracer.trace_dir,
+                    )
                 if pf is not None:
                     with spans.span("prefetch_stall"):
                         try:
@@ -613,7 +771,19 @@ def fit(
                         state, loss, norms = train_step(state, batch, targets)
                     else:
                         state, loss = train_step(state, batch, targets)
+                warm["train"] = True
                 host_step += 1
+                recorder.record("step", step=host_step, epoch=epoch)
+                if tracer is not None and tracer.tracing and tracer.step():
+                    logger.log(
+                        kind="anomaly_trace", event="stopped", step=host_step
+                    )
+                if (
+                    checksum_fn is not None
+                    and host_step % flags.divergence_check_freq == 0
+                ):
+                    with spans.span("telemetry"):
+                        pending_checks.append((host_step, checksum_fn(state)))
                 running = loss if running is None else running + loss
                 # Honest throughput (VERDICT r2 #8): count only original
                 # dataset rows — wrap-padding duplicates train but are not
@@ -660,9 +830,43 @@ def fit(
                             pstats["occupancy"], 3
                         )
                     logger.log(**record)
+                    recorder.record(
+                        "window", step=host_step, epoch=epoch, loss=avg,
+                        goodput=win["goodput"],
+                        window_s=round(win["total_s"], 6),
+                    )
+                    if (
+                        watchdog is not None
+                        and len(watchdog.hang_events) > hangs_logged
+                    ):
+                        # the monitor thread already dumped the bundle(s);
+                        # surface the event in the JSONL from this thread
+                        # and trace the recovery steps. hang_events pairs
+                        # each overrun with ITS bundle (None if the dump
+                        # budget was spent), so the record never points at
+                        # an unrelated sentinel bundle.
+                        new_events = watchdog.hang_events[hangs_logged:]
+                        hangs_logged = len(watchdog.hang_events)
+                        logger.log(
+                            kind="watchdog", event="hang", step=host_step,
+                            hangs=len(watchdog.hang_events),
+                            bundles=[
+                                e["bundle"] for e in new_events if e["bundle"]
+                            ],
+                        )
+                        note_anomaly("hang", host_step)
                     running = None
+                    if pending_checks:
+                        with spans.span("telemetry"):
+                            flush_checks()
                     if heart is not None:
-                        heart.beat(host_step)
+                        heart.beat(
+                            host_step,
+                            checksum=last_checksum[1] if last_checksum else None,
+                            checksum_step=(
+                                last_checksum[0] if last_checksum else None
+                            ),
+                        )
                         if p0:
                             # step_lag = one window: SPMD lockstep keeps
                             # healthy processes equal, so a process a full
@@ -674,7 +878,52 @@ def fit(
                                     kind="straggler", step=host_step,
                                     stragglers=stragglers,
                                 )
+                                recorder.record(
+                                    "straggler", step=host_step,
+                                    stragglers=stragglers,
+                                )
                                 print(f"heartbeat: straggling processes {stragglers}")
+                                note_anomaly("straggler", host_step)
+                                dump_bundle(
+                                    "straggler", host_step,
+                                    stragglers=stragglers,
+                                )
+                            if checksum_fn is not None:
+                                # beats republish their latest checksum
+                                # every window; report each mismatching
+                                # (process, step, checksum) ONCE
+                                diverged = [
+                                    m for m in heart.check_divergence()
+                                    if (
+                                        m["process"], m["checksum_step"],
+                                        m["checksum"],
+                                    ) not in reported_divergence
+                                ]
+                                if diverged:
+                                    reported_divergence.update(
+                                        (
+                                            m["process"], m["checksum_step"],
+                                            m["checksum"],
+                                        )
+                                        for m in diverged
+                                    )
+                                    logger.log(
+                                        kind="divergence", step=host_step,
+                                        mismatches=diverged,
+                                    )
+                                    recorder.record(
+                                        "divergence", step=host_step,
+                                        mismatches=diverged,
+                                    )
+                                    print(
+                                        "divergence: replica checksum "
+                                        f"mismatch {diverged}"
+                                    )
+                                    note_anomaly("divergence", host_step)
+                                    dump_bundle(
+                                        "divergence", host_step,
+                                        mismatches=diverged,
+                                    )
                     if sentinel is not None:
                         event = sentinel.observe(avg, host_step)
                         if event is not None:
@@ -683,6 +932,12 @@ def fit(
                                 kind="spike", action=flags.spike_action,
                                 **event.record(),
                             )
+                            recorder.record(
+                                "spike", step=event.step, event=event.kind,
+                                action=flags.spike_action,
+                            )
+                            note_anomaly(event.kind, host_step)
+                            dump_bundle(event.kind, host_step)
                             if p0:
                                 print(
                                     f"loss sentinel: {event.kind} at step "
@@ -711,16 +966,25 @@ def fit(
                                     f"checkpointed at {checkpoint_path}"
                                 )
                 if flags.checkpoint_every and host_step % flags.checkpoint_every == 0:
+                    if watchdog is not None:
+                        # checkpoint I/O (sync writer: encode + disk) may
+                        # legitimately exceed the step deadline; the next
+                        # iteration re-arms
+                        watchdog.disarm()
                     # Async: only the snapshot is charged here; the encode +
                     # disk write overlaps the following steps.
                     with spans.span("checkpoint"):
                         checkpoint_path = (
                             save_checkpoint(state) or checkpoint_path
                         )
+                    recorder.record("checkpoint", step=host_step)
             # Close THIS epoch's prefetcher + bar now (pop_all keeps the
             # fit-lifetime stack from accumulating dead objects across
             # epochs; the stack still covers exceptional unwinds above).
             _cleanup.pop_all().close()
+            pf_live["pf"] = None
+            if watchdog is not None:
+                watchdog.disarm()
 
             # ---- validation ---------------------------------------------
             bar = tqdm(validation_loader, disable=not p0)
@@ -730,6 +994,10 @@ def fit(
             total_loss, total_acc, total_weight = 0.0, 0.0, 0.0
             eval_metrics = {"loss": float("nan"), "accuracy": float("nan")}
             for i, raw in enumerate(bar):
+                # eval steps hang in the same collectives train steps do;
+                # same deadline, same first-call compile exemption
+                if watchdog is not None and warm["eval"]:
+                    watchdog.arm(host_step)
                 with spans.span("eval"):
                     batch, targets = prepare_batch(raw, tokenizer.pad_token_id)
                     if host_batch is not None:
@@ -748,6 +1016,7 @@ def fit(
                     # (caught by tests/test_multiprocess.py).
                     weight = float(_valid_count(targets))
                     loss, acc = eval_step(state, batch, targets)
+                    warm["eval"] = True
                     if weight > 0.0:
                         total_loss += float(loss) * weight
                         total_acc += float(acc) * weight
@@ -762,6 +1031,11 @@ def fit(
                     f"loss: {eval_metrics['loss']:.3f}, accuracy: {eval_metrics['accuracy']:.2f}"
                 )
             logger.log(kind="validation", epoch=epoch, **eval_metrics)
+            recorder.record("validation", epoch=epoch, **eval_metrics)
+            if watchdog is not None:
+                # generation + epoch-end checkpointing have their own (much
+                # longer) natural durations; the next epoch's loop re-arms
+                watchdog.disarm()
 
             # ---- qualitative eval (all processes compute — the replication
             # inside generate_samples is collective — process 0 prints) ----
@@ -786,8 +1060,18 @@ def fit(
                 total_s=ep["total_s"], seconds=ep["seconds"],
                 fractions=ep["fractions"],
             )
+            recorder.record(
+                "epoch", epoch=epoch, goodput=ep["goodput"],
+                total_s=round(ep["total_s"], 6),
+            )
+            if pending_checks:
+                flush_checks()  # checks taken since the last window
             if heart is not None:
-                heart.beat(host_step)
+                heart.beat(
+                    host_step,
+                    checksum=last_checksum[1] if last_checksum else None,
+                    checksum_step=last_checksum[0] if last_checksum else None,
+                )
             if p0:
                 print(f"epoch {epoch+1} wallclock: {format_breakdown(ep)}")
 

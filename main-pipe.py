@@ -35,4 +35,11 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    from tpukit.recovery import run_recipe
+
+    # Exit-code contract (docs/DESIGN.md "recovery", README): 0 clean,
+    # 75 preempted-and-checkpointed, 76 anomaly abort, 77 rollback budget
+    # exhausted — what a babysitter script keys its relaunch decision on.
+    sys.exit(run_recipe(main))

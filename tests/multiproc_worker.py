@@ -24,6 +24,7 @@ sys.path.insert(0, str(REPO))
 
 import tpukit  # noqa: F401  (TPUKIT_CPU_DEVICES -> cpu platform config)
 from tpukit.mesh import initialize_runtime  # noqa: E402
+from tpukit.recovery import TrainingAborted  # noqa: E402
 
 initialize_runtime()
 
@@ -43,7 +44,14 @@ def main() -> None:
     spec.loader.exec_module(mod)
 
     os.chdir(workdir)
-    result = mod.main(recipe_args)
+    try:
+        result = mod.main(recipe_args)
+    except TrainingAborted as exc:
+        # The recipes' __main__ guard maps these onto the documented exit
+        # codes (tpukit/recovery.py); the worker must honor the same
+        # contract so the SIGTERM kill-midrun harness can assert on it.
+        print(f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        sys.exit(exc.exit_code)
 
     out = {
         "rank": jax.process_index(),

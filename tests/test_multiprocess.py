@@ -348,6 +348,155 @@ def test_fsdp_kill_midrun_resume(tmp_path, writer_args):
     assert np.isfinite(resumed[0]["eval_loss"])
 
 
+def _wait_for_checkpoint(proc, ckdir: Path, pattern: str, timeout_s: float = 300):
+    """Block until the run publishes its first periodic checkpoint (the
+    signal that training is genuinely mid-epoch) or the process exits."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if ckdir.is_dir() and list(ckdir.glob(pattern)):
+            return
+        if proc.poll() is not None:
+            out = proc.communicate()[0]
+            raise AssertionError(
+                f"run exited rc={proc.returncode} before any checkpoint:\n"
+                + out[-3000:]
+            )
+        time.sleep(0.02)
+    raise AssertionError("no checkpoint published within the deadline")
+
+
+def test_sigterm_midrun_graceful_checkpoint_and_bitexact_resume(tmp_path):
+    """Round-9 preemption, through the REAL CLI: SIGTERM a mid-epoch
+    `main-single.py`, assert the documented exit-code contract (75 =
+    preempted-and-checkpointed, tpukit/recovery.py), then `--resume
+    latest` must reproduce the uninterrupted run's final checkpoint
+    BIT-exact — the same parity methodology as the kill-midrun harness,
+    with a graceful signal instead of SIGKILL. (Single-process tier-1
+    twin of the 2-process slow-tier variant below.)"""
+    import signal as signal_mod
+
+    run_args = [
+        "--dataset_slice", "400",  # 50 steps: SIGTERM lands mid-epoch
+        "--checkpoint_every", "2",
+        "--compilation_cache_dir", str(REPO / ".jax_cache"),
+    ]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def _launch(workdir, extra=()):
+        return subprocess.Popen(
+            [sys.executable, str(REPO / "main-single.py")]
+            + TINY_ARGS + run_args + list(extra),
+            cwd=workdir, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+
+    control = tmp_path / "control"
+    control.mkdir()
+    proc = _launch(control)
+    out = proc.communicate(timeout=600)[0]
+    assert proc.returncode == 0, out[-3000:]  # exit-code contract: clean
+
+    victim = tmp_path / "victim"
+    victim.mkdir()
+    proc = _launch(victim)
+    try:
+        _wait_for_checkpoint(
+            proc, victim / "checkpoints", "checkpoint-*.msgpack"
+        )
+        proc.send_signal(signal_mod.SIGTERM)
+        out = proc.communicate(timeout=600)[0]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    # exit-code contract: preempted AND checkpointed — 75 (EX_TEMPFAIL),
+    # the code a babysitter keys "relaunch with --resume latest" on
+    assert proc.returncode == 75, f"rc={proc.returncode}\n{out[-3000:]}"
+    assert "preempted by SIGTERM" in out
+
+    import tpukit.checkpoint as ckpt_lib
+
+    newest = ckpt_lib.latest(victim / "checkpoints")
+    meta = ckpt_lib.read_meta(newest)
+    assert meta is not None and meta["preempted"] and meta["signal"] == "SIGTERM"
+
+    resume = _launch(victim, extra=["--resume", "latest"])
+    out = resume.communicate(timeout=600)[0]
+    assert resume.returncode == 0, out[-3000:]
+
+    final = "checkpoint-step000000050.msgpack"
+    a = (control / "checkpoints" / final).read_bytes()
+    b = (victim / "checkpoints" / final).read_bytes()
+    assert a == b  # bit-exact: the preemption lost nothing
+
+
+@pytest.mark.slow
+def test_fsdp_two_process_sigterm_graceful_resume(tmp_path):
+    """2-process variant: SIGTERM both ranks mid-epoch. Host loops poll
+    their signal flags at independent wall-clocks, so the graceful save is
+    collectivized through `--heartbeat_dir` (recovery.PreemptCoordinator:
+    p0 publishes a decision naming a window boundary every rank's
+    deterministic host-step counter passes through) — the step-keyed
+    sharded save then matches on all ranks; both exit 75; the relaunched
+    world continues from the preemption step."""
+    import signal as signal_mod
+
+    run_args = [
+        "--dataset_slice", "2048",  # 32 steps/epoch at global batch 64
+        "--checkpoint_every", "2",
+        "--checkpoint_format", "sharded",
+        "--heartbeat_dir", str(tmp_path / "hb"),
+    ]
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update(
+            TPUKIT_CPU_DEVICES="4",
+            JAX_COORDINATOR_ADDRESS=f"localhost:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(rank),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(WORKER), "main-fsdp.py", str(tmp_path),
+                 str(tmp_path / f"sigterm_{rank}.json")] + TINY_ARGS + run_args,
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+        )
+    ckdir = tmp_path / "checkpoints"
+    try:
+        _wait_for_checkpoint(procs[0], ckdir, "*.sharded")
+        for p in procs:
+            p.send_signal(signal_mod.SIGTERM)
+        logs = [p.communicate(timeout=600)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for rank, (p, log) in enumerate(zip(procs, logs)):
+        assert p.returncode == 75, f"rank {rank} rc={p.returncode}:\n{log[-3000:]}"
+
+    import tpukit.checkpoint as ckpt_lib
+
+    preempt_step = ckpt_lib._step_of(ckpt_lib.latest_sharded(ckdir))
+    assert preempt_step >= 2
+
+    resumed = _launch_world(
+        "main-fsdp.py", tmp_path, extra=run_args + ["--resume", "latest"]
+    )
+    steps_per_epoch = 2048 // 64
+    # mid-epoch resume: the world finishes exactly the interrupted epoch
+    assert resumed[0]["step"] == steps_per_epoch
+    assert abs(resumed[0]["eval_loss"] - resumed[1]["eval_loss"]) < 1e-5
+    assert np.isfinite(resumed[0]["eval_loss"])
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "schedule_args", [[], ["--schedule", "1f1b"]], ids=["gpipe", "1f1b"]

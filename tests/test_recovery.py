@@ -1,0 +1,971 @@
+"""Round-9 recovery: the detect→recover loop, tested end to end.
+
+Rounds 6-8 could DETECT every major failure class (NaN/spike sentinels,
+hang watchdog, heartbeat stragglers, cross-replica divergence) but the only
+response was checkpoint-then-abort. This file tests the round-9 response
+machinery:
+
+  - the chaos fault-injection harness (tpukit/chaos.py): spec grammar,
+    exact-step firing, occurrence-indexed I/O faults, fire-once semantics;
+  - jittered-exponential retry/backoff for transient host I/O
+    (tpukit/retry.py): budget, fail-loud, never-retry-programming-errors,
+    observer events;
+  - checkpoint integrity (tpukit/checkpoint.py): sha256 sidecars /
+    manifest checksums at save, corrupt/partial checkpoints skipped by
+    `latest`/`latest_any`/`latest_good` with a warning, resume-metadata
+    sidecars;
+  - the recovery engine (tpukit/recovery.py): rollback planning against
+    the budget, quarantine of the abandoned timeline, the collective-
+    decision coordinator, the preemption guard, the exit-code contract;
+  - fit() end to end: an injected NaN rolls the run back to the last good
+    checkpoint and the post-recovery trajectory is BIT-EXACT with an
+    uninjected control run restored at the same checkpoint (the chaos
+    `skip@N` stream fast-forward reproduces the recovered run's input
+    position); budget 0 escalates to the documented abort; an injected
+    SIGTERM checkpoints gracefully and `--resume latest` continues to a
+    bit-exact final state; injected transient I/O faults are absorbed by
+    the backoff wrapper and leave `retry` records;
+  - HLO invariance: the chaos flag off/on leaves the compiled train step
+    byte-identical (all injection is host-side).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from tpukit import chaos as chaos_lib
+from tpukit import checkpoint as ckpt_lib
+from tpukit import retry as retry_lib
+from tpukit.recovery import (
+    EXIT_ANOMALY_ABORT,
+    EXIT_CLEAN,
+    EXIT_PREEMPTED,
+    EXIT_ROLLBACK_EXHAUSTED,
+    AnomalyAbort,
+    Preempted,
+    PreemptionGuard,
+    RecoveryEngine,
+    RollbackBudgetExhausted,
+    RollbackCoordinator,
+    RollbackPlan,
+    TrainingAborted,
+    run_recipe,
+)
+
+# ---------------------------------------------------------------------------
+# chaos: spec grammar + engine semantics
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_spec_parses_all_kinds():
+    entries = chaos_lib.parse_spec(
+        "nan_loss@120, spike_loss@7:50, sigterm@300,hang@450:2.5,"
+        "bitflip@10:1,ckpt_io_fail@2:3,loader_io_fail@1,skip@17"
+    )
+    by_kind = {e["kind"]: e for e in entries}
+    assert by_kind["nan_loss"] == {"kind": "nan_loss", "at": 120, "param": None}
+    assert by_kind["spike_loss"]["param"] == 50.0
+    assert by_kind["hang"]["param"] == 2.5
+    assert by_kind["ckpt_io_fail"] == {"kind": "ckpt_io_fail", "at": 2, "param": 3.0}
+    assert by_kind["skip"]["at"] == 17
+
+
+@pytest.mark.parametrize(
+    "bad", ["nan_loss", "nan_loss@", "@12", "frobnicate@3", "nan_loss@x"]
+)
+def test_chaos_spec_rejects_typos_at_startup(bad):
+    """A typo'd fault plan must fail loudly when parsed, not silently never
+    fire mid-run."""
+    with pytest.raises(chaos_lib.ChaosSpecError, match="chaos spec"):
+        chaos_lib.parse_spec(bad)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "hang@10:-2",        # would crash mid-run in time.sleep
+        "spike_loss@7:0",    # multiplier 0 is not a spike — never fires
+        "ckpt_io_fail@0",    # occurrences are 1-based: @0 never fires
+        "loader_io_fail@2:0",  # failure count 0 never fires
+    ],
+)
+def test_chaos_spec_rejects_insane_params_at_startup(bad):
+    """Param sanity is part of the fail-at-startup contract: a plan that
+    parses but crashes mid-run or silently never fires means a CI chaos
+    test can silently test nothing."""
+    with pytest.raises(chaos_lib.ChaosSpecError, match="chaos spec"):
+        chaos_lib.parse_spec(bad)
+
+
+def test_chaos_bitflip_target_must_be_in_world():
+    # a target process outside the world would silently never flip — the
+    # divergence test downstream would then be testing nothing
+    with pytest.raises(chaos_lib.ChaosSpecError, match="out of range"):
+        chaos_lib.ChaosEngine("bitflip@5:9", process_count=4)
+    eng = chaos_lib.ChaosEngine("bitflip@5:3", process_count=4)  # in range
+    assert eng.mutates_state_at(5)
+
+
+def test_chaos_step_fault_fires_exactly_once():
+    import jax.numpy as jnp
+
+    eng = chaos_lib.ChaosEngine("nan_loss@5")
+    loss = jnp.asarray(2.5, dtype=jnp.float32)
+    state = {"w": jnp.zeros(3)}
+    s, l, fired = eng.on_step(4, state, loss)
+    assert not fired and float(l) == 2.5
+    s, l, fired = eng.on_step(5, state, loss)
+    assert fired and np.isnan(float(l))
+    assert s is state  # nan_loss poisons the OBSERVED loss, never the state
+    # post-rollback the step counter repeats 5 — the fault must not re-fire
+    s, l, fired = eng.on_step(5, state, loss)
+    assert not fired and float(l) == 2.5
+
+
+def test_chaos_spike_mult_and_bitflip_targeting():
+    import jax.numpy as jnp
+
+    eng = chaos_lib.ChaosEngine("spike_loss@3:100,bitflip@4:1", process_index=0,
+                                process_count=2)
+    loss = jnp.asarray(2.0, dtype=jnp.float32)
+    _, l, _ = eng.on_step(3, {"w": jnp.ones(3)}, loss)
+    assert float(l) == 200.0
+    # bitflip targets process 1; process 0's state must be untouched
+    state = {"w": jnp.ones(3, dtype=jnp.float32)}
+    s, _, fired = eng.on_step(4, state, loss)
+    assert fired[0]["process"] == 1 and "flipped" not in fired[0]
+    assert s is state
+
+    other = chaos_lib.ChaosEngine("bitflip@4:1", process_index=1, process_count=2)
+    s2, _, fired2 = other.on_step(4, state, loss)
+    assert fired2[0].get("flipped") is True
+    changed = np.asarray(s2["w"]) != np.asarray(state["w"])
+    assert changed.sum() == 1  # exactly one element, one mantissa bit
+    assert np.isfinite(np.asarray(s2["w"])).all()
+
+
+def test_chaos_io_fault_occurrence_and_consecutive_counting():
+    """`ckpt_io_fail@2:2`: the 2nd ckpt_write OPERATION fails its first two
+    attempts (retries re-enter without advancing the occurrence), then
+    succeeds; other occurrences pass untouched."""
+    eng = chaos_lib.ChaosEngine("ckpt_io_fail@2:2")
+    eng.io_fault("ckpt_write")  # occurrence 1: clean
+    with pytest.raises(IOError):
+        eng.io_fault("ckpt_write")  # occurrence 2, attempt 1: injected
+    with pytest.raises(IOError):
+        eng.io_fault("ckpt_write")  # occurrence 2, attempt 2: injected
+    eng.io_fault("ckpt_write")  # occurrence 2, attempt 3: recovers
+    eng.io_fault("ckpt_write")  # occurrence 3: clean
+    assert len(eng.fired) == 2
+    # an unrelated site never sees the plan
+    eng2 = chaos_lib.ChaosEngine("ckpt_io_fail@1")
+    eng2.io_fault("loader_fetch")
+
+
+def test_chaos_module_hooks_install_and_clear():
+    assert chaos_lib.installed() is None
+    chaos_lib.maybe_io_fault("ckpt_write")  # no harness: a no-op
+    eng = chaos_lib.ChaosEngine("ckpt_io_fail@1")
+    prev = chaos_lib.install(eng)
+    try:
+        assert prev is None and chaos_lib.installed() is eng
+        with pytest.raises(IOError):
+            chaos_lib.maybe_io_fault("ckpt_write")
+    finally:
+        chaos_lib.install(prev)
+    assert chaos_lib.installed() is None
+
+
+# ---------------------------------------------------------------------------
+# retry: policy + wrapper semantics
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_validates():
+    with pytest.raises(ValueError):
+        retry_lib.RetryPolicy(retries=-1)
+    with pytest.raises(ValueError):
+        retry_lib.RetryPolicy(jitter=1.0)
+    with pytest.raises(ValueError):
+        retry_lib.RetryPolicy(base_delay=-0.1)
+
+
+def test_retry_delay_exponential_and_capped():
+    import random
+
+    pol = retry_lib.RetryPolicy(retries=8, base_delay=0.1, max_delay=1.0, jitter=0.0)
+    rng = random.Random(0)
+    delays = [pol.delay(k, rng) for k in range(1, 7)]
+    assert delays[:4] == [0.1, 0.2, 0.4, 0.8]
+    assert delays[4] == delays[5] == 1.0  # capped
+    jittered = retry_lib.RetryPolicy(retries=3, base_delay=0.1, jitter=0.5)
+    for k in (1, 2, 3):
+        d = jittered.delay(k, rng)
+        base = min(0.1 * 2 ** (k - 1), jittered.max_delay)
+        assert 0.5 * base <= d <= 1.5 * base
+
+
+def test_retry_io_recovers_within_budget_and_observes():
+    calls = {"n": 0}
+    slept = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise IOError(f"transient {calls['n']}")
+        return "ok"
+
+    events = []
+    assert (
+        retry_lib.retry_io(
+            flaky, label="t", policy=retry_lib.RetryPolicy(retries=3),
+            sleep=slept.append,
+        )
+        == "ok"
+    )
+    assert calls["n"] == 3 and len(slept) == 2
+    # the observer path (fit installs a RetryLog)
+    log = retry_lib.RetryLog()
+    retry_lib.set_observer(log)
+    try:
+        calls["n"] = 0
+        retry_lib.retry_io(
+            flaky, label="obs", policy=retry_lib.RetryPolicy(retries=3),
+            sleep=lambda s: None,
+        )
+    finally:
+        retry_lib.set_observer(None)
+    events = log.drain()
+    assert [e["attempt"] for e in events] == [1, 2]
+    assert all(e["label"] == "obs" for e in events)
+    assert log.total == 2 and log.drain() == []  # total survives draining
+
+
+def test_retry_io_fails_loud_after_budget():
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise IOError("still down")
+
+    with pytest.raises(IOError, match="still down"):
+        retry_lib.retry_io(
+            always, policy=retry_lib.RetryPolicy(retries=2),
+            sleep=lambda s: None,
+        )
+    assert calls["n"] == 3  # 1 attempt + 2 retries, then the REAL error
+
+
+def test_retry_io_never_retries_programming_errors():
+    calls = {"n": 0}
+
+    def buggy():
+        calls["n"] += 1
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        retry_lib.retry_io(buggy, sleep=lambda s: None)
+    assert calls["n"] == 1  # retrying a bug just repeats it slower
+
+
+def test_retry_zero_budget_is_one_attempt():
+    def always():
+        raise IOError("x")
+
+    with pytest.raises(IOError):
+        retry_lib.retry_io(
+            always, policy=retry_lib.RetryPolicy(retries=0),
+            sleep=lambda s: None,
+        )
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: checksums at save, corrupt skipped at resolve
+# ---------------------------------------------------------------------------
+
+
+def _fake_state(step: int):
+    """A minimal pytree with a .step — enough for the consolidated writer."""
+    from flax import struct
+
+    @struct.dataclass
+    class S:
+        step: int
+        w: np.ndarray
+
+    return S(step=step, w=np.arange(8, dtype=np.float32) + step)
+
+
+def test_consolidated_save_writes_verifying_sidecar(tmp_path):
+    path = ckpt_lib.save(_fake_state(3), tmp_path)
+    side = ckpt_lib.checksum_sidecar(path)
+    assert side.exists()
+    assert side.read_text().strip() == hashlib.sha256(path.read_bytes()).hexdigest()
+    ok, detail = ckpt_lib.verify_checkpoint(path)
+    assert ok and detail == "verified"
+
+
+def test_latest_skips_corrupt_checkpoint_with_warning(tmp_path):
+    good = ckpt_lib.save(_fake_state(4), tmp_path)
+    bad = ckpt_lib.save(_fake_state(8), tmp_path)
+    bad.write_bytes(b"bitrot" + bad.read_bytes()[6:])  # same size, wrong bytes
+    ok, detail = ckpt_lib.verify_checkpoint(bad)
+    assert not ok and "mismatch" in detail
+    with pytest.warns(UserWarning, match="skipping corrupt checkpoint"):
+        assert ckpt_lib.latest(tmp_path) == good
+    with pytest.warns(UserWarning):
+        assert ckpt_lib.latest_any(tmp_path) == good
+    assert ckpt_lib.latest(tmp_path, verify=False) == bad  # escape hatch
+
+
+def test_missing_sidecar_is_legacy_not_corrupt(tmp_path):
+    """Pre-round-9 checkpoints (no sidecar) must stay restorable."""
+    path = ckpt_lib.save(_fake_state(5), tmp_path)
+    ckpt_lib.checksum_sidecar(path).unlink()
+    ok, detail = ckpt_lib.verify_checkpoint(path)
+    assert ok and "legacy" in detail
+    assert ckpt_lib.latest(tmp_path) == path
+
+
+def test_latest_good_respects_max_step(tmp_path):
+    for step in (2, 4, 6, 8):
+        ckpt_lib.save(_fake_state(step), tmp_path)
+    assert ckpt_lib._step_of(ckpt_lib.latest_good(tmp_path)) == 8
+    assert ckpt_lib._step_of(ckpt_lib.latest_good(tmp_path, max_step=5)) == 4
+    assert ckpt_lib._step_of(ckpt_lib.latest_good(tmp_path, max_step=4)) == 4
+    assert ckpt_lib.latest_good(tmp_path, max_step=1) is None
+
+
+def test_meta_sidecar_roundtrip(tmp_path):
+    meta = {"step": 7, "epoch": 1, "batch_in_epoch": 3, "preempted": True}
+    path = ckpt_lib.save(_fake_state(7), tmp_path, meta=meta)
+    assert ckpt_lib.read_meta(path) == meta
+    plain = ckpt_lib.save(_fake_state(9), tmp_path)
+    assert ckpt_lib.read_meta(plain) is None
+
+
+def test_sharded_manifest_records_checksums_and_verifies(tmp_path, tiny_config):
+    """Single-process sharded save: the manifest must carry a sha256 per
+    shard file; corrupting a shard or deleting it flips verification, and
+    `latest_sharded` skips the corrupt directory for an older good one."""
+    from tpukit.model import init_params
+    from tpukit.train import create_train_state, make_optimizer
+
+    state = create_train_state(
+        jax.random.PRNGKey(0), tiny_config, make_optimizer(1e-3)
+    )
+    old = ckpt_lib.save_sharded(
+        state.replace(step=state.step * 0 + 1), tmp_path, meta={"step": 1}
+    )
+    new = ckpt_lib.save_sharded(state.replace(step=state.step * 0 + 2), tmp_path)
+    manifest = json.loads((new / "manifest.json").read_text())
+    shard = new / "shard-00000.npz"
+    assert manifest["checksums"][shard.name] == hashlib.sha256(
+        shard.read_bytes()
+    ).hexdigest()
+    assert ckpt_lib.verify_checkpoint(new) == (True, "verified")
+    assert ckpt_lib.read_meta(old) == {"step": 1}
+
+    shard.write_bytes(shard.read_bytes()[:-4] + b"\x00\x00\x00\x00")
+    ok, detail = ckpt_lib.verify_checkpoint(new)
+    assert not ok and "mismatch" in detail
+    with pytest.warns(UserWarning, match="skipping corrupt checkpoint"):
+        assert ckpt_lib.latest_sharded(tmp_path) == old
+
+    shard.unlink()
+    ok, detail = ckpt_lib.verify_checkpoint(new)
+    assert not ok and "missing shard" in detail
+
+
+# ---------------------------------------------------------------------------
+# recovery engine: budget, planning, quarantine, coordinator, exit codes
+# ---------------------------------------------------------------------------
+
+
+def test_exit_code_contract_values():
+    """The documented contract (README): these are load-bearing for any
+    babysitter script keying relaunch decisions on them — moving one is a
+    breaking change."""
+    assert EXIT_CLEAN == 0
+    assert EXIT_PREEMPTED == 75  # EX_TEMPFAIL: relaunch with --resume latest
+    assert EXIT_ANOMALY_ABORT == 76
+    assert EXIT_ROLLBACK_EXHAUSTED == 77
+    assert Preempted("x").exit_code == 75
+    assert AnomalyAbort("x").exit_code == 76
+    assert RollbackBudgetExhausted("x").exit_code == 77
+    assert issubclass(RollbackBudgetExhausted, AnomalyAbort)
+
+
+def test_run_recipe_maps_exceptions_to_exit_codes():
+    assert run_recipe(lambda argv: None) == 0
+    assert run_recipe(lambda argv: (_ for _ in ()).throw(Preempted("p"))) == 75
+    assert run_recipe(lambda argv: (_ for _ in ()).throw(AnomalyAbort("a"))) == 76
+    assert (
+        run_recipe(
+            lambda argv: (_ for _ in ()).throw(RollbackBudgetExhausted("r"))
+        )
+        == 77
+    )
+    with pytest.raises(KeyError):  # unexpected crashes keep their traceback
+        run_recipe(lambda argv: (_ for _ in ()).throw(KeyError("boom")))
+
+
+def test_recovery_plan_picks_newest_good_outside_window(tmp_path):
+    for step in (2, 4, 6, 8):
+        ckpt_lib.save(_fake_state(step), tmp_path)
+    eng = RecoveryEngine(tmp_path, max_rollbacks=2)
+    plan = eng.plan("nan", anomaly_step=9, window=4)
+    assert plan.target_step == 4  # newest with step <= 9 - 4
+    assert plan.steps_lost == 5 and plan.seq == 1
+    eng.committed(plan)
+    assert eng.count == 1 and eng.steps_lost == 5
+
+
+def test_recovery_budget_exhaustion_and_no_candidate(tmp_path):
+    eng = RecoveryEngine(tmp_path, max_rollbacks=0)
+    assert eng.plan("nan", 10, window=0) is None and eng.exhausted
+    ckpt_lib.save(_fake_state(6), tmp_path)
+    eng2 = RecoveryEngine(tmp_path, max_rollbacks=3)
+    # nothing restorable OLDER than the window -> same escalation
+    assert eng2.plan("nan", 5, window=4) is None and eng2.exhausted
+    with pytest.raises(ValueError):
+        RecoveryEngine(tmp_path, max_rollbacks=-1)
+
+
+def test_quarantine_renames_suspect_timeline_aside(tmp_path):
+    for step in (2, 4, 6, 8):
+        ckpt_lib.save(_fake_state(step), tmp_path, meta={"step": step})
+    eng = RecoveryEngine(tmp_path, max_rollbacks=3)
+    plan = eng.plan("spike", anomaly_step=9, window=4)  # target step 4
+    names = eng.quarantine(plan)
+    assert sorted(names) == [
+        "checkpoint-step000000006.msgpack.quarantined-0001",
+        "checkpoint-step000000008.msgpack.quarantined-0001",
+    ]
+    # the poisoned timeline is invisible to every resolution path now
+    assert ckpt_lib._step_of(ckpt_lib.latest(tmp_path)) == 4
+    assert ckpt_lib._step_of(ckpt_lib.latest_good(tmp_path)) == 4
+    # sidecars went aside with their blobs (no orphan checksum/meta files)
+    assert not ckpt_lib.checksum_sidecar(
+        tmp_path / "checkpoint-step000000008.msgpack"
+    ).exists()
+    assert not ckpt_lib.meta_path(
+        tmp_path / "checkpoint-step000000008.msgpack"
+    ).exists()
+    # non-process-0 never touches the shared filesystem
+    assert eng.quarantine(plan, process_zero=False) == []
+
+
+def test_coordinator_confirm_rejects_split_brain(tmp_path):
+    plan = RollbackPlan(
+        seq=1, reason="nan", anomaly_step=20, target_step=12,
+        target_path="c", steps_lost=8,
+    )
+    p0 = RollbackCoordinator(tmp_path, process_index=0, process_count=2)
+    p1 = RollbackCoordinator(
+        tmp_path, process_index=1, process_count=2, timeout_s=0.3
+    )
+    # timeout first: no decision file yet
+    with pytest.raises(TrainingAborted, match="timed out"):
+        p1.confirm(plan)
+    p0.publish(plan)
+    p1.confirm(plan)  # matching plan: returns quietly
+    import dataclasses
+
+    skewed = dataclasses.replace(plan, target_step=8)
+    with pytest.raises(TrainingAborted, match="split-brain"):
+        p1.confirm(skewed)
+    p1.ack(1, 12)
+    acks = list(Path(tmp_path).glob("rollback-0001-ack-*.json"))
+    assert len(acks) == 1
+    # deferred decisions carry their execution boundary
+    p0.publish(plan, execute_after=28)
+    assert p0.read(1)["execute_after"] == 28
+    # single-process worlds never touch the filesystem
+    solo = RollbackCoordinator(None)
+    solo.publish(plan)
+    solo.confirm(plan)
+    assert solo.read(1) is None
+
+
+def test_coordinator_publish_abort_round_trips(tmp_path):
+    # budget exhausted on a p0-only anomaly (divergence): the abort is a
+    # published decision every rank executes at the boundary, never a
+    # lone-p0 abort (whose autopsy checkpoint collective would strand the
+    # other ranks)
+    p0 = RollbackCoordinator(tmp_path, process_index=0, process_count=2)
+    p0.publish_abort(1, "divergence", anomaly_step=40, execute_after=48)
+    rec = p0.read(1)
+    assert rec["action"] == "abort"
+    assert rec["reason"] == "divergence"
+    assert rec["anomaly_step"] == 40 and rec["execute_after"] == 48
+    # single-process worlds never touch the filesystem
+    solo = RollbackCoordinator(None)
+    solo.publish_abort(1, "divergence", anomaly_step=40, execute_after=48)
+    assert solo.read(1) is None
+
+
+def test_coordinator_final_drain_rendezvous(tmp_path):
+    # A deferred decision published during the LAST training window is
+    # read at the end-of-epoch drain — but a fast rank's lone read can
+    # land BEFORE slow p0's publish (p0 detects divergence inside its
+    # boundary block: heartbeat reads + hashing). The drain is therefore
+    # a rendezvous: ranks must not trust a None read until p0's marker
+    # exists, and the marker is only written after everything p0 will
+    # ever publish is on disk.
+    p0 = RollbackCoordinator(tmp_path, process_index=0, process_count=2)
+    p1 = RollbackCoordinator(
+        tmp_path, process_index=1, process_count=2, timeout_s=0.3
+    )
+    # no marker yet: the wait must time out LOUD, never silently proceed
+    with pytest.raises(TrainingAborted, match="final-drain marker"):
+        p1.wait_final_drain()
+    plan = RollbackPlan(
+        seq=1, reason="divergence", anomaly_step=20, target_step=12,
+        target_path="c", steps_lost=8,
+    )
+    p0.publish(plan, execute_after=28)  # publish strictly before marker
+    p0.publish_final_drain(24)
+    p1.wait_final_drain()  # returns promptly now
+    assert p1.read(1)["execute_after"] == 28
+    # p0 itself never waits; non-p0 never publishes the marker
+    p0.wait_final_drain()
+    p1.publish_final_drain(24)
+    # the marker lives in the rollback-*.json namespace, so a relaunched
+    # incarnation's construction sweep clears it with the decisions
+    RollbackCoordinator(tmp_path, process_index=0, process_count=2)
+    with pytest.raises(TrainingAborted, match="final-drain marker"):
+        p1.wait_final_drain()
+    # single-process worlds never touch the filesystem
+    solo = RollbackCoordinator(None)
+    solo.publish_final_drain(24)
+    solo.wait_final_drain()
+
+
+def test_verify_checkpoint_vanishing_file_skips_not_crashes(tmp_path, monkeypatch):
+    # During a collective rollback every rank runs latest_good over the
+    # shared directory while p0 concurrently quarantine-renames the
+    # suspect checkpoints: a candidate can pass the exists() probes and
+    # vanish before the hash opens it. The warn-and-skip contract demands
+    # (False, detail) — an OSError escaping verify_checkpoint would crash
+    # the rank unclassified and strand the others in the rollback
+    # collectives.
+    good = ckpt_lib.save(_fake_state(4), tmp_path)
+    doomed = ckpt_lib.save(_fake_state(8), tmp_path)
+    real = ckpt_lib._sha256_file
+
+    def racing_sha256(path):
+        if Path(path).name == doomed.name:
+            raise FileNotFoundError(f"quarantine race: {path} renamed away")
+        return real(path)
+
+    monkeypatch.setattr(ckpt_lib, "_sha256_file", racing_sha256)
+    ok, detail = ckpt_lib.verify_checkpoint(doomed)
+    assert not ok and "unreadable" in detail
+    with pytest.warns(UserWarning, match="skipping corrupt checkpoint"):
+        assert ckpt_lib.latest_good(tmp_path) == good
+    assert ckpt_lib.verify_checkpoint(good) == (True, "verified")
+
+
+def test_chaos_mutates_state_at_flags_only_bitflips():
+    # the trainer brackets on_step with a prefetcher quiesce exactly when
+    # the fault will device_put into the state (bitflip) — loss poisoning
+    # and signals never pay the quiesce
+    eng = chaos_lib.ChaosEngine("nan_loss@3,bitflip@5,hang@7")
+    assert not eng.mutates_state_at(3)
+    assert eng.mutates_state_at(5)
+    assert not eng.mutates_state_at(7)
+    # fire-once: after the step executes, the quiesce is no longer needed
+    import jax.numpy as jnp
+
+    eng.on_step(5, {"w": jnp.zeros((2,))}, jnp.float32(1.0))
+    assert not eng.mutates_state_at(5)
+
+
+def test_preempt_coordinator_request_decide_protocol(tmp_path):
+    from tpukit.recovery import PreemptCoordinator
+
+    p0 = PreemptCoordinator(tmp_path, process_index=0, process_count=2)
+    p1 = PreemptCoordinator(tmp_path, process_index=1, process_count=2)
+    assert p0.any_request() is None and p0.read() is None
+    # rank 1's signal lands first: it publishes a request (idempotent)
+    p1.request("SIGTERM")
+    p1.request("SIGTERM")
+    reqs = list(Path(tmp_path).glob("preempt-request-p*.json"))
+    assert len(reqs) == 1
+    assert p0.any_request() == "SIGTERM"
+    # p0 turns the first request into the decision; first decision wins
+    dec = p0.publish("SIGTERM", execute_after=48)
+    assert dec == {"signal": "SIGTERM", "execute_after": 48, "run_start": 0}
+    assert p0.publish("SIGINT", execute_after=64) == dec  # idempotent
+    assert p1.read() == dec
+    # single-process worlds never construct one, but None-dir is inert
+    solo = PreemptCoordinator(None)
+    solo.request("SIGTERM")
+    assert solo.any_request() is None and solo.read() is None
+
+
+def test_preempt_coordinator_clears_stale_incarnation_state(tmp_path):
+    # The decision/request files survive the incarnation that wrote them.
+    # A relaunched run must NOT re-read them: its first poll would match
+    # the stale decision and preempt again with no signal pending — every
+    # relaunch exits 75 and the run never makes progress.
+    from tpukit.recovery import PreemptCoordinator
+
+    old0 = PreemptCoordinator(tmp_path, process_index=0, process_count=2)
+    old1 = PreemptCoordinator(tmp_path, process_index=1, process_count=2)
+    old1.request("SIGTERM")
+    old0.publish("SIGTERM", execute_after=48)
+    # relaunch: each rank clears its own request, p0 clears the decision
+    new1 = PreemptCoordinator(tmp_path, process_index=1, process_count=2)
+    new0 = PreemptCoordinator(tmp_path, process_index=0, process_count=2)
+    assert new0.read() is None
+    assert new0.any_request() is None
+    assert new1.read() is None
+    # ... and even when the cleanup LOSES the relaunch race (a fast rank
+    # polls before a slow p0's init sweep), the incarnation tag rejects
+    # the leftovers: the resumed run's start step (48 here — it restored
+    # the preemption checkpoint saved at execute_after) differs from the
+    # old incarnation's tag, so a surviving decision/request never matches.
+    old0b = PreemptCoordinator(tmp_path, process_index=0, process_count=2)
+    old1b = PreemptCoordinator(tmp_path, process_index=1, process_count=2)
+    old1b.request("SIGTERM")
+    old0b.publish("SIGTERM", execute_after=48)
+    racer = PreemptCoordinator.__new__(PreemptCoordinator)  # no cleanup ran
+    racer.directory = Path(tmp_path)
+    racer.process_index = 1
+    racer.process_count = 2
+    racer._requested = False
+    racer.run_start = 48
+    assert racer.read() is None
+    assert racer.any_request() is None
+    # same incarnation tag on both sides round-trips normally
+    old1b.run_start = 48
+    old1b._requested = False
+    old0b.run_start = 48
+    old1b.request("SIGTERM")
+    dec = old0b.publish("SIGTERM", execute_after=96)
+    assert racer.read() == dec and racer.any_request() == "SIGTERM"
+
+
+def test_rollback_coordinator_clears_stale_incarnation_state(tmp_path):
+    # A new incarnation restarts its rollback seq at 1; a surviving
+    # rollback-0001.json would either execute a spurious rollback at the
+    # first boundary or, via the in-flight dedup, suppress every real
+    # deferred rollback of the resumed run.
+    plan = RollbackPlan(
+        seq=1, reason="divergence", anomaly_step=20, target_step=12,
+        target_path="c", steps_lost=8,
+    )
+    old0 = RollbackCoordinator(tmp_path, process_index=0, process_count=2)
+    old0.publish(plan, execute_after=28)
+    RollbackCoordinator(tmp_path, process_index=1, process_count=2).ack(1, 12)
+    assert old0.read(1) is not None
+    new0 = RollbackCoordinator(tmp_path, process_index=0, process_count=2)
+    assert new0.read(1) is None
+    assert not list(Path(tmp_path).glob("rollback-*.json"))
+    # non-p0 ranks never clear (p0 owns the channel); a rank constructed
+    # before a straggling p0 must not see the old decision either once p0
+    # arrives — but it must not delete p0's files itself
+    old0.publish(plan, execute_after=28)
+    RollbackCoordinator(tmp_path, process_index=1, process_count=2)
+    assert old0.read(1) is not None
+
+
+def test_preemption_guard_sets_flag_and_restores_handlers():
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as guard:
+        assert guard.pending is None
+        signal.raise_signal(signal.SIGTERM)
+        assert guard.pending == "SIGTERM"
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+# ---------------------------------------------------------------------------
+# fit() end to end: the detect→recover loop on a real run
+# ---------------------------------------------------------------------------
+
+TINY = dict(
+    batch_size=8, epochs=1, sequence_length=33, dim=32, head_dim=8, heads=4,
+    num_layers=2, learning_rate=1e-3, dataset_slice="200", num_workers=0,
+    disable_amp=True, seed=0, checkpoint_every=4, spike_threshold=8.0,
+)
+# 200 rows / batch 8 = 25 steps; PRINT_FREQ=8 windows at batch index 8, 16,
+# 24; nan_loss@12 poisons the window ending at step 17, whose newest
+# checkpoint outside the window (17 - 8 = 9) is step 8.
+
+
+def _run_fit(tmp, log_name, **overrides):
+    from tpukit.flags import TrainFlags
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import fit
+
+    flags = TrainFlags(**{**TINY, "metrics_log": str(tmp / log_name), **overrides})
+    cwd = os.getcwd()
+    os.chdir(tmp)  # checkpoints/ lands in tmp
+    try:
+        result = fit(flags, SingleDevice())
+    finally:
+        os.chdir(cwd)
+    records = [
+        json.loads(line) for line in (tmp / log_name).read_text().splitlines()
+    ]
+    return result, records
+
+
+@pytest.fixture(scope="module")
+def chaos_rollback_run(tmp_path_factory):
+    """The acceptance scenario: nan_loss@12 + --on_anomaly rollback. The
+    run must detect at the window boundary (step 17), roll back to the
+    step-8 checkpoint, keep the stream moving forward, and complete."""
+    tmp = tmp_path_factory.mktemp("chaos_rollback")
+    result, records = _run_fit(
+        tmp, "run.jsonl", chaos_spec="nan_loss@12", on_anomaly="rollback",
+        max_rollbacks=2,
+    )
+    return tmp, result, records
+
+
+def test_chaos_rollback_completes_and_logs_the_loop(chaos_rollback_run):
+    tmp, result, records = chaos_rollback_run
+    kinds = [r["kind"] for r in records]
+    assert "chaos" in kinds and "spike" in kinds and "rollback" in kinds
+    rb = next(r for r in records if r["kind"] == "rollback")
+    assert rb["reason"] == "nan"
+    assert rb["anomaly_step"] == 17 and rb["target_step"] == 8
+    assert rb["steps_lost"] == 9 and rb["timeline"] == 1
+    assert len(rb["quarantined"]) == 2  # poisoned steps 12 and 16
+    # the run COMPLETED: validation ran, the final state is healthy, and
+    # the step counter reflects the replayed window (25 batches, 9 steps
+    # lost to the rollback -> final step 16)
+    assert any(r["kind"] == "validation" for r in records)
+    assert int(jax.device_get(result.state.step)) == 16
+    last_window = [r for r in records if r["kind"] == "train"][-1]
+    assert np.isfinite(last_window["loss"])
+    # quarantined names never resolve again
+    assert ckpt_lib._step_of(ckpt_lib.latest(tmp / "checkpoints")) == 16
+
+
+def test_chaos_rollback_trajectory_matches_restored_control(
+    chaos_rollback_run, tmp_path_factory
+):
+    """THE acceptance criterion: the post-recovery trajectory equals an
+    uninjected control run restored at the same checkpoint with the stream
+    fast-forwarded to the same position (chaos `skip@17` — the recovered
+    run had consumed batches 0..16 when it rolled back)."""
+    tmp, result, records = chaos_rollback_run
+    control = tmp_path_factory.mktemp("control")
+    target = tmp / "checkpoints" / "checkpoint-step000000008.msgpack"
+    ctrl_result, ctrl_records = _run_fit(
+        control, "run.jsonl", resume=str(target), chaos_spec="skip@17"
+    )
+    # bit-exact final states: identical bytes on disk
+    a = (tmp / "checkpoints" / "checkpoint-step000000016.msgpack").read_bytes()
+    b = (control / "checkpoints" / "checkpoint-step000000016.msgpack").read_bytes()
+    assert hashlib.sha256(a).hexdigest() == hashlib.sha256(b).hexdigest()
+    # and the post-recovery window losses agree exactly, window by window
+    rb_idx = next(i for i, r in enumerate(records) if r["kind"] == "rollback")
+    post = [r["loss"] for r in records[rb_idx:] if r["kind"] == "train"]
+    ctrl = [r["loss"] for r in ctrl_records if r["kind"] == "train"]
+    assert post and post == ctrl
+
+
+@pytest.fixture(scope="module")
+def exhausted_abort_run(tmp_path_factory):
+    """Budget 0 + transient I/O faults: the same injection must escalate to
+    the round-8 bundle-dump-and-abort path with the documented exit code,
+    while the inert I/O faults are absorbed by the retry wrapper."""
+    from tpukit.recovery import RollbackBudgetExhausted
+
+    tmp = tmp_path_factory.mktemp("chaos_abort")
+    with pytest.raises(RollbackBudgetExhausted) as excinfo:
+        _run_fit(
+            tmp, "run.jsonl",
+            chaos_spec="nan_loss@12,ckpt_io_fail@1:2,loader_io_fail@2",
+            on_anomaly="rollback", max_rollbacks=0,
+        )
+    records = [
+        json.loads(line) for line in (tmp / "run.jsonl").read_text().splitlines()
+    ]
+    return tmp, excinfo.value, records
+
+
+def test_budget_zero_escalates_with_documented_exit_code(exhausted_abort_run):
+    tmp, exc, records = exhausted_abort_run
+    assert exc.exit_code == EXIT_ROLLBACK_EXHAUSTED
+    assert "budget exhausted" in str(exc)
+    # the blown-up state was checkpointed for autopsy (the round-8 tail)
+    assert "checkpoint-step000000017" in str(exc)
+    assert (tmp / "checkpoints" / "checkpoint-step000000017.msgpack").exists()
+    assert not any(r["kind"] == "rollback" for r in records)
+
+
+def test_transient_io_faults_retried_and_recorded(exhausted_abort_run):
+    _, _, records = exhausted_abort_run
+    retries = [r for r in records if r["kind"] == "retry"]
+    labels = {r["label"] for r in retries}
+    assert {"ckpt_write", "loader_fetch"} <= labels
+    # 2 consecutive ckpt failures + 1 loader failure, all within the
+    # default budget of 3: the run never saw an error
+    assert len([r for r in retries if r["label"] == "ckpt_write"]) == 2
+    for r in retries:
+        assert r["retries"] == 3 and r["delay_s"] >= 0
+        assert "chaos: injected transient" in r["error"]
+
+
+@pytest.fixture(scope="module")
+def preempted_run(tmp_path_factory):
+    """Chaos-injected SIGTERM mid-epoch: graceful checkpoint with resume
+    metadata, Preempted(exit 75), then `--resume latest` continues to the
+    uninterrupted run's final state bit-exact."""
+    tmp = tmp_path_factory.mktemp("preempt")
+    with pytest.raises(Preempted) as excinfo:
+        _run_fit(tmp, "run1.jsonl", chaos_spec="sigterm@13")
+    records1 = [
+        json.loads(line) for line in (tmp / "run1.jsonl").read_text().splitlines()
+    ]
+    result2, records2 = _run_fit(tmp, "run2.jsonl", resume="latest")
+    control = tmp_path_factory.mktemp("preempt_control")
+    _run_fit(control, "run.jsonl")
+    return tmp, control, excinfo.value, records1, result2
+
+
+def test_preemption_checkpoints_and_reports(preempted_run):
+    tmp, _, exc, records1, _ = preempted_run
+    assert exc.exit_code == EXIT_PREEMPTED
+    assert exc.step == 13
+    pre = next(r for r in records1 if r["kind"] == "preempt")
+    assert pre["signal"] == "SIGTERM" and pre["step"] == 13
+    assert pre["epoch"] == 0 and pre["batch_in_epoch"] == 13
+    meta = ckpt_lib.read_meta(
+        tmp / "checkpoints" / "checkpoint-step000000013.msgpack"
+    )
+    assert meta["preempted"] and meta["batch_in_epoch"] == 13
+
+
+def test_preempted_resume_is_bit_exact_with_uninterrupted(preempted_run):
+    tmp, control, _, _, result2 = preempted_run
+    assert int(jax.device_get(result2.state.step)) == 25
+    a = (tmp / "checkpoints" / "checkpoint-step000000025.msgpack").read_bytes()
+    b = (control / "checkpoints" / "checkpoint-step000000025.msgpack").read_bytes()
+    assert hashlib.sha256(a).hexdigest() == hashlib.sha256(b).hexdigest()
+
+
+def test_fit_rejects_bad_recovery_flags(tmp_path):
+    from tpukit.flags import TrainFlags
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import fit
+
+    with pytest.raises(ValueError, match="max_rollbacks"):
+        fit(TrainFlags(**TINY, max_rollbacks=-1), SingleDevice())
+    with pytest.raises(ValueError, match="io_retries"):
+        fit(TrainFlags(**TINY, io_retries=-1), SingleDevice())
+    with pytest.raises(chaos_lib.ChaosSpecError):
+        fit(TrainFlags(**TINY, chaos_spec="frobnicate@3"), SingleDevice())
+
+
+def test_fit_resume_rejects_corrupt_checkpoint(tmp_path):
+    from tpukit.flags import TrainFlags
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import fit
+
+    ckdir = tmp_path / "checkpoints"
+    ckdir.mkdir()
+    bad = ckdir / "checkpoint-step000000004.msgpack"
+    bad.write_bytes(b"garbage")
+    ckpt_lib.checksum_sidecar(bad).write_text("0" * 64)
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        with pytest.raises(ValueError, match="integrity"):
+            fit(TrainFlags(**TINY, resume=str(bad)), SingleDevice())
+    finally:
+        os.chdir(cwd)
+
+
+def test_chaos_flag_leaves_train_step_hlo_byte_identical(tiny_config):
+    """Zero behavior change when no fault fires: all injection is host-side,
+    so the compiled train step is byte-identical with the harness installed
+    (the acceptance criterion's HLO check)."""
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    opt = make_optimizer(1e-3)
+    shapes = jax.eval_shape(
+        lambda: create_train_state(jax.random.PRNGKey(0), tiny_config, opt)
+    )
+    batch = {
+        "input_ids": jax.ShapeDtypeStruct((4, 16), np.int32),
+        "position_ids": jax.ShapeDtypeStruct((4, 16), np.int32),
+        "mask": jax.ShapeDtypeStruct((4, 16), np.bool_),
+    }
+    targets = jax.ShapeDtypeStruct((4, 16), np.int32)
+    step_off, _, _ = make_step_fns(tiny_config, opt, SingleDevice(), shapes)
+    hlo_off = step_off.lower(shapes, batch, targets).compile().as_text()
+    prev = chaos_lib.install(chaos_lib.ChaosEngine("nan_loss@10,ckpt_io_fail@1"))
+    try:
+        step_on, _, _ = make_step_fns(tiny_config, opt, SingleDevice(), shapes)
+        hlo_on = step_on.lower(shapes, batch, targets).compile().as_text()
+    finally:
+        chaos_lib.install(prev)
+    assert hlo_on == hlo_off
+
+
+# ---------------------------------------------------------------------------
+# tools: report.py + flightview.py render the new kinds
+# ---------------------------------------------------------------------------
+
+
+def test_report_renders_recovery_section(chaos_rollback_run):
+    from tools.report import summarize
+
+    _, _, records = chaos_rollback_run
+    text = summarize(records)
+    assert "== recovery ==" in text
+    assert "rollbacks: 1   total steps lost: 9" in text
+    assert "restored step 8" in text
+    assert "chaos faults fired" in text
+
+
+def test_report_renders_preempt_and_retries():
+    from tools.report import summarize
+
+    records = [
+        {"kind": "preempt", "step": 13, "signal": "SIGTERM",
+         "epoch": 0, "batch_in_epoch": 13, "checkpoint": "c/ck.msgpack"},
+        {"kind": "retry", "step": 9, "label": "ckpt_write", "attempt": 1,
+         "retries": 3, "delay_s": 0.05, "error": "OSError: x"},
+        {"kind": "retry", "step": 9, "label": "loader_fetch", "attempt": 1,
+         "retries": 3, "delay_s": 0.05, "error": "OSError: x"},
+    ]
+    text = summarize(records)
+    assert "preempted: SIGTERM at step 13" in text
+    assert "io retries: 2" in text and "ckpt_write x1" in text
+
+
+def test_flightview_headlines_recovery_ring_events():
+    from tools.flightview import render
+
+    bundle = {
+        "reason": "nan", "step": 17, "time": 0.0,
+        "ring": [
+            {"t": 0.0, "kind": "step", "step": 16},
+            {"t": 0.0, "kind": "rollback", "seq": 1, "reason": "nan",
+             "anomaly_step": 17, "target_step": 8, "steps_lost": 9},
+            {"t": 0.0, "kind": "retry", "label": "ckpt_write", "attempt": 1},
+            {"t": 0.0, "kind": "preempt", "signal": "SIGTERM", "step": 20},
+        ],
+    }
+    text = render(bundle)
+    assert "== recovery events (from the ring) ==" in text
+    assert "rollback #1 [nan] anomaly step 17 -> restored step 8" in text
+    assert "preempt SIGTERM at step 20" in text
+    assert "retry x1" in text

@@ -234,7 +234,8 @@ def test_save_auto_with_unwritable_consolidated_is_never_called(monkeypatch):
     monkeypatch.setattr(ckpt_lib, "save", boom)
     called = {}
     monkeypatch.setattr(
-        ckpt_lib, "save_sharded", lambda s, d="checkpoints", n=None: called.setdefault("ok", True)
+        ckpt_lib, "save_sharded",
+        lambda s, d="checkpoints", n=None, meta=None: called.setdefault("ok", True),
     )
     assert ckpt_lib.save_auto(state) is True
     assert called["ok"]

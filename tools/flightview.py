@@ -107,6 +107,28 @@ def render(bundle: dict, ring_tail: int = 25, full_stacks: bool = False) -> str:
             for item in bundle[key]:
                 w(f"  {item}")
 
+    # Round-9 recovery events in the ring deserve a headline before the raw
+    # tail: a bundle from a run that already rolled back / retried I/O /
+    # fired injected faults reads differently from a first failure.
+    recov = [
+        r for r in (bundle.get("ring") or [])
+        if r.get("kind") in ("rollback", "preempt", "retry", "chaos")
+    ]
+    if recov:
+        w("== recovery events (from the ring) ==")
+        counts: dict[str, int] = {}
+        for r in recov:
+            counts[r["kind"]] = counts.get(r["kind"], 0) + 1
+        w("  " + "  ".join(f"{k} x{v}" for k, v in sorted(counts.items())))
+        for r in recov:
+            if r["kind"] == "rollback":
+                w(f"  rollback #{r.get('seq', '?')} [{r.get('reason', '?')}] "
+                  f"anomaly step {r.get('anomaly_step', '?')} -> restored "
+                  f"step {r.get('target_step', '?')} "
+                  f"({r.get('steps_lost', '?')} steps lost)")
+            elif r["kind"] == "preempt":
+                w(f"  preempt {r.get('signal', '?')} at step {r.get('step', '?')}")
+
     stacks = bundle.get("stacks") or {}
     if stacks:
         w(f"== thread stacks ({len(stacks)}) ==")

@@ -13,7 +13,11 @@ Train windows from a prefetching run additionally carry
 in the training section. Round-8 failure observability adds "watchdog"
 (hang/bundle events — bundles themselves render via tools/flightview.py),
 "divergence"/"divergence_check" (cross-replica checksums), and
-"anomaly_trace" (trace-on-anomaly lifecycle). This tool needs NOTHING but
+"anomaly_trace" (trace-on-anomaly lifecycle). Round-9 recovery adds
+"rollback" (in-process restores: count, steps lost, quarantined
+checkpoints), "preempt" (graceful SIGTERM/SIGINT checkpoint-and-exit),
+"retry" (transient host-I/O attempts absorbed by backoff), and "chaos"
+(the fault-injection audit trail). This tool needs NOTHING but
 the file — no jax import, so it runs anywhere the log was copied to.
 
 Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
@@ -199,6 +203,44 @@ def summarize(records: list[dict]) -> str:
         w("== stragglers ==")
         for r in stragglers:
             w(f"  step {r.get('step', '?')}: {r.get('stragglers')}")
+    # round-9 recovery: in-process rollbacks, graceful preemption, retried
+    # transient I/O, and the chaos audit trail
+    rollbacks = _rows(records, "rollback")
+    preempts = _rows(records, "preempt")
+    retries = _rows(records, "retry")
+    chaos = _rows(records, "chaos")
+    if rollbacks or preempts or retries or chaos:
+        w("== recovery ==")
+    if rollbacks:
+        lost = sum(r.get("steps_lost", 0) for r in rollbacks)
+        w(f"  rollbacks: {len(rollbacks)}   total steps lost: {lost}")
+        for r in rollbacks:
+            w(f"    #{r.get('seq', '?')} [{r.get('reason', '?')}] at step "
+              f"{r.get('anomaly_step', '?')} -> restored step "
+              f"{r.get('target_step', '?')} "
+              f"({r.get('steps_lost', '?')} steps lost"
+              + (f", {len(r['quarantined'])} checkpoint(s) quarantined"
+                 if r.get("quarantined") else "")
+              + ")")
+    for r in preempts:
+        w(f"  preempted: {r.get('signal', '?')} at step {r.get('step', '?')} "
+          f"-> checkpoint {r.get('checkpoint', '?')} "
+          f"(resume at epoch {r.get('epoch', '?')}, "
+          f"batch {r.get('batch_in_epoch', '?')})")
+    if retries:
+        by_label: dict[str, int] = {}
+        for r in retries:
+            by_label[r.get("label", "?")] = by_label.get(r.get("label", "?"), 0) + 1
+        w(f"  io retries: {len(retries)} ("
+          + "  ".join(f"{k} x{v}" for k, v in sorted(by_label.items())) + ")")
+    if chaos:
+        # occurrence-indexed I/O faults also carry a drain-time "step"
+        # (the trainer stamps one on every chaos event), so the
+        # occurrence — the index the spec named — must win when present
+        w(f"  chaos faults fired: {len(chaos)} ("
+          + ", ".join(
+              f"{r.get('fault', '?')}@{r.get('occurrence', r.get('step', '?'))}"
+              for r in chaos) + ")")
     # round-8 failure observability: hang-watchdog events, cross-replica
     # divergence, anomaly-trace lifecycle
     watchdog = _rows(records, "watchdog")

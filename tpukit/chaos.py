@@ -1,0 +1,338 @@
+"""Deterministic fault injection: the chaos harness behind `--chaos_spec`.
+
+Rounds 6-8 built detection (sentinels, watchdog, divergence checksums) and
+round 9 builds recovery (rollback, preemption, retry) — but a recovery
+path that only executes when production actually fails is untested code on
+the critical path. This module closes that gap: every failure class the
+detectors know is injectable AT AN EXACT STEP, seeded and replayable, so
+the detect→recover loop runs end to end in CI on a healthy host.
+
+Spec grammar (documented in docs/DESIGN.md "recovery"):
+
+    --chaos_spec "nan_loss@120,sigterm@300,ckpt_io_fail@2,hang@450:2.5"
+
+    spec   := entry ("," entry)*
+    entry  := kind "@" int (":" float)?     # the float is kind-specific
+
+step-indexed kinds (`@N` = fires when training step N completes):
+    nan_loss@N        poison the host-observed loss with NaN (the state is
+                      untouched — the detector/recovery path is the target)
+    spike_loss@N[:m]  multiply the observed loss by m (default 1e3)
+    sigterm@N         raise SIGTERM in-process (the preemption path)
+    sigint@N          raise SIGINT in-process
+    hang@N[:s]        sleep s seconds (default 1.0) inside the armed
+                      iteration — trips the hang watchdog
+    bitflip@N[:p]     flip one mantissa bit of the first parameter leaf on
+                      process p (default: the last process) — a divergent
+                      replica for the checksum detector
+    skip@N            consume (discard) the first N batches of the first
+                      trained epoch before training starts — the stream
+                      fast-forward primitive, exposed so a control run can
+                      reproduce a rollback's post-recovery stream exactly
+
+occurrence-indexed kinds (`@K` = the K-th I/O operation of that site
+fails; `:c` = fail c consecutive attempts, default 1 — c <= --io_retries
+is recovered by the backoff wrapper, c > fails loud):
+    ckpt_io_fail@K[:c]     checkpoint write path (sync + async writers)
+    ckpt_read_fail@K[:c]   checkpoint read path (restore)
+    loader_io_fail@K[:c]   DataLoader batch fetch
+
+Injection sites call the module-level hooks (`maybe_io_fault`), which are
+a single `is None` test when no harness is installed — chaos off costs
+one predictable branch per I/O call and NOTHING in the compiled step (all
+injection is host-side; the train-step HLO is byte-identical with the
+flag on or off, asserted in tests/test_recovery.py).
+"""
+
+from __future__ import annotations
+
+import re
+import signal
+import threading
+import time
+
+STEP_KINDS = ("nan_loss", "spike_loss", "sigterm", "sigint", "hang", "bitflip")
+IO_KINDS = ("ckpt_io_fail", "ckpt_read_fail", "loader_io_fail")
+# io-site label (as used by the checkpoint/loader call sites) per kind
+_IO_SITE = {
+    "ckpt_io_fail": "ckpt_write",
+    "ckpt_read_fail": "ckpt_read",
+    "loader_io_fail": "loader_fetch",
+}
+
+_ENTRY_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)@(?P<at>\d+)(?::(?P<param>[0-9.eE+-]+))?$"
+)
+
+
+class ChaosSpecError(ValueError):
+    pass
+
+
+def parse_spec(spec: str) -> list[dict]:
+    """Parse the `--chaos_spec` grammar into a list of
+    {kind, at, param} dicts. Raises ChaosSpecError with the offending
+    entry named — a typo'd fault plan must fail at startup, not silently
+    never fire."""
+    entries = []
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        m = _ENTRY_RE.match(raw)
+        if not m:
+            raise ChaosSpecError(
+                f"chaos spec entry {raw!r} does not match kind@step[:param]"
+            )
+        kind = m.group("kind")
+        if kind not in STEP_KINDS + IO_KINDS + ("skip",):
+            raise ChaosSpecError(
+                f"chaos spec entry {raw!r}: unknown kind {kind!r} "
+                f"(known: {', '.join(STEP_KINDS + IO_KINDS + ('skip',))})"
+            )
+        param = m.group("param")
+        entry = {
+            "kind": kind,
+            "at": int(m.group("at")),
+            "param": float(param) if param is not None else None,
+        }
+        # Param sanity is part of the fail-at-startup contract: a plan
+        # that parses but then crashes mid-run (time.sleep(-2)) or
+        # silently never fires (I/O occurrence 0, failure count 0) is the
+        # exact failure mode this parser exists to prevent.
+        if kind == "hang" and entry["param"] is not None and entry["param"] < 0:
+            raise ChaosSpecError(
+                f"chaos spec entry {raw!r}: hang duration must be >= 0"
+            )
+        if kind == "spike_loss" and entry["param"] is not None and entry["param"] <= 0:
+            raise ChaosSpecError(
+                f"chaos spec entry {raw!r}: spike multiplier must be > 0"
+            )
+        if kind in IO_KINDS:
+            if entry["at"] < 1:
+                raise ChaosSpecError(
+                    f"chaos spec entry {raw!r}: I/O occurrences are 1-based "
+                    f"(@0 would never fire)"
+                )
+            if entry["param"] is not None and int(entry["param"]) < 1:
+                raise ChaosSpecError(
+                    f"chaos spec entry {raw!r}: failure count must be >= 1 "
+                    f"(0 would never fire)"
+                )
+        entries.append(entry)
+    return entries
+
+
+class ChaosEngine:
+    """One run's fault plan. Deterministic and replayable: the same spec
+    (plus seed, for any future randomized kinds) fires the same faults at
+    the same steps/occurrences on every run.
+
+    The trainer calls `on_step` after each completed training step; the
+    I/O sites call `io_fault(site)` from inside their retried operation.
+    Each fired fault is recorded in `fired` (and returned to the caller)
+    so the run's JSONL carries a `kind="chaos"` audit trail.
+    """
+
+    def __init__(self, spec: str, seed: int = 0, process_index: int = 0,
+                 process_count: int = 1):
+        self.spec = spec
+        self.seed = seed
+        self.process_index = process_index
+        self.process_count = process_count
+        self._lock = threading.Lock()
+        self.fired: list[dict] = []
+        self._step_faults: dict[int, list[dict]] = {}
+        # per-site: {occurrence_index: remaining_failures}
+        self._io_plan: dict[str, dict[int, int]] = {s: {} for s in _IO_SITE.values()}
+        self._io_seen: dict[str, int] = {s: 0 for s in _IO_SITE.values()}
+        self.skip_batches = 0
+        for e in parse_spec(spec):
+            if e["kind"] == "bitflip" and e["param"] is not None and not (
+                0 <= int(e["param"]) < process_count
+            ):
+                # a target outside the world would silently never flip —
+                # the CI divergence test would then test nothing
+                raise ChaosSpecError(
+                    f"chaos spec bitflip@{e['at']}:{int(e['param'])}: target "
+                    f"process out of range for world size {process_count}"
+                )
+            if e["kind"] == "skip":
+                self.skip_batches = max(self.skip_batches, e["at"])
+            elif e["kind"] in IO_KINDS:
+                site = _IO_SITE[e["kind"]]
+                count = int(e["param"]) if e["param"] is not None else 1
+                self._io_plan[site][e["at"]] = count
+            else:
+                self._step_faults.setdefault(e["at"], []).append(e)
+
+    # -- step-indexed faults (training thread) -----------------------------
+
+    def mutates_state_at(self, step: int) -> bool:
+        """True when a fault scheduled at `step` will device_put into the
+        state (bitflip). The trainer brackets that `on_step` call with a
+        prefetcher quiesce — the same two-threads-never-place rule the
+        rollback restore follows (prefetch.HostPrefetcher.quiesce)."""
+        return any(
+            f["kind"] == "bitflip" for f in self._step_faults.get(step, ())
+        )
+
+    def on_step(self, step: int, state, loss):
+        """Apply any fault scheduled for `step`. Returns
+        (state, loss, fired_events); state/loss are unchanged unless a
+        fault targets them."""
+        faults = self._step_faults.pop(step, None)
+        if not faults:
+            return state, loss, []
+        events = []
+        for f in faults:
+            kind, param = f["kind"], f["param"]
+            ev = {"fault": kind, "step": step}
+            if kind == "nan_loss":
+                loss = self._poison_loss(loss, float("nan"))
+            elif kind == "spike_loss":
+                loss = self._poison_loss(loss, None, mult=param or 1e3)
+                ev["mult"] = param or 1e3
+            elif kind == "sigterm":
+                signal.raise_signal(signal.SIGTERM)
+            elif kind == "sigint":
+                signal.raise_signal(signal.SIGINT)
+            elif kind == "hang":
+                dur = param if param is not None else 1.0
+                ev["sleep_s"] = dur
+                time.sleep(dur)
+            elif kind == "bitflip":
+                target = (
+                    int(param) if param is not None else self.process_count - 1
+                )
+                ev["process"] = target
+                if target == self.process_index:
+                    state = self._flip_bit(state)
+                    ev["flipped"] = True
+            events.append(ev)
+        with self._lock:
+            self.fired.extend(events)
+        return state, loss, events
+
+    @staticmethod
+    def _poison_loss(loss, value, mult=None):
+        import jax.numpy as jnp
+
+        if mult is not None:
+            return loss * jnp.asarray(mult, dtype=loss.dtype)
+        return jnp.full_like(loss, value)
+
+    @staticmethod
+    def _flip_bit(state):
+        """Flip one low mantissa bit of the first parameter leaf — the
+        minimal divergence the XOR checksum must catch. Placement (device
+        + sharding) is preserved so the perturbed state re-enters the
+        donated step unchanged in layout. Cross-host-sharded leaves (not
+        fully addressable — device_get would raise) are perturbed through
+        their first LOCAL shard and reassembled in place."""
+        import jax
+        import numpy as np
+
+        def _flip_first(arr):
+            flat = np.array(arr, copy=True).reshape(-1)
+            bits = flat[:1].view(
+                {2: np.uint16, 4: np.uint32, 8: np.uint64}[arr.dtype.itemsize]
+            )
+            bits[0] ^= 1
+            return flat.reshape(arr.shape)
+
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        for i, leaf in enumerate(leaves):
+            dtype = np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+            if dtype.kind != "f" or getattr(leaf, "size", 0) == 0:
+                continue
+            if (
+                isinstance(leaf, jax.Array)
+                and not leaf.is_fully_addressable
+            ):
+                shards = leaf.addressable_shards
+                if not shards or shards[0].data.size == 0:
+                    continue
+                bufs = [
+                    jax.device_put(
+                        _flip_first(np.asarray(s.data)) if j == 0
+                        else np.asarray(s.data),
+                        s.device,
+                    )
+                    for j, s in enumerate(shards)
+                ]
+                leaves[i] = jax.make_array_from_single_device_arrays(
+                    leaf.shape, leaf.sharding, bufs
+                )
+                break
+            arr = np.asarray(jax.device_get(leaf))
+            flipped = _flip_first(arr)
+            sharding = getattr(leaf, "sharding", None)
+            leaves[i] = (
+                jax.device_put(flipped, sharding)
+                if sharding is not None
+                else jax.numpy.asarray(flipped)
+            )
+            break
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # -- occurrence-indexed I/O faults (any thread) ------------------------
+
+    def io_fault(self, site: str) -> None:
+        """Called from inside a retried I/O operation; raises IOError when
+        this occurrence (1-based, per site) is scheduled to fail. A
+        scheduled count of c fails the first c ATTEMPTS of that occurrence
+        (retries re-enter here without advancing the occurrence index)."""
+        with self._lock:
+            plan = self._io_plan.get(site)
+            if plan is None:
+                return
+            seen = self._io_seen[site] + 1
+            remaining = plan.get(seen)
+            if remaining is not None and remaining > 0:
+                plan[seen] = remaining - 1
+                self.fired.append(
+                    {"fault": f"{site}_io", "occurrence": seen,
+                     "remaining": remaining - 1}
+                )
+                raise IOError(
+                    f"chaos: injected transient {site} failure "
+                    f"(occurrence {seen})"
+                )
+            # the occurrence completed (or was never scheduled): advance
+            self._io_seen[site] = seen
+
+    def drain_fired(self) -> list[dict]:
+        """Events fired since the last drain (the trainer logs these as
+        kind=\"chaos\" JSONL records)."""
+        with self._lock:
+            out, self.fired = self.fired, []
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Module-level injection hooks. The I/O sites (checkpoint.py, loader.py)
+# call `maybe_io_fault(site)` unconditionally — a no-op unless a harness
+# is installed (one None check). fit() installs the engine for the run's
+# duration and uninstalls it on exit, so chaos never leaks across fits.
+# ---------------------------------------------------------------------------
+
+_ENGINE: ChaosEngine | None = None
+
+
+def install(engine: ChaosEngine | None) -> ChaosEngine | None:
+    """Install (or clear, with None) the process-wide engine; returns the
+    previous one so callers can restore it."""
+    global _ENGINE
+    prev, _ENGINE = _ENGINE, engine
+    return prev
+
+
+def installed() -> ChaosEngine | None:
+    return _ENGINE
+
+
+def maybe_io_fault(site: str) -> None:
+    eng = _ENGINE
+    if eng is not None:
+        eng.io_fault(site)

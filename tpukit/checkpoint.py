@@ -23,21 +23,46 @@ Checkpoints are step-keyed (`checkpoint-step000000123.*`), so periodic saves
 never collide (two saves in the same wall-clock second used to overwrite
 each other) and `latest`/`latest_any` resume picks by training step, not by
 timestamp string sort.
+
+Round 9 (the detect→recover loop) adds three properties this file is now
+the source of truth for:
+
+  - **Integrity**: every save records a content checksum — a sha256
+    sidecar (`<name>.msgpack.sha256`) for the consolidated format, a
+    `checksums` map inside `manifest.json` for the sharded one — and
+    `latest`/`latest_any`/`latest_good` SKIP corrupt or partial
+    checkpoints (checksum mismatch, missing manifest/shards) with a
+    warning instead of restoring garbage. "Roll back to the last good
+    checkpoint" means the last one that passes `verify_checkpoint`.
+  - **Resume metadata**: saves can carry a small `meta` sidecar
+    (`read_meta`/`meta_path`) recording the epoch + batch position (and
+    whether the save was a preemption save), which is what lets
+    `--resume latest` continue a preempted run MID-epoch bit-exact.
+  - **Transient-fault tolerance**: the raw file I/O (blob/shard/manifest
+    writes, blob reads) runs under `tpukit.retry.retry_io` — a jittered
+    exponential backoff that fails loud after its budget — with
+    `tpukit.chaos` injection hooks inside the retried operations so the
+    path is deterministically testable.
 """
 
 from __future__ import annotations
 
 import functools
+import hashlib
+import json
 import os
 import re
 import threading
 import time
+import warnings
 from pathlib import Path
 
 import jax
 from flax import serialization
 
+from tpukit import chaos as chaos_lib
 from tpukit.mesh import is_process_zero, sync_global_devices
+from tpukit.retry import retry_io
 
 
 def step_name(state) -> str:
@@ -57,7 +82,153 @@ def _step_of(path: Path) -> int:
     return int(m.group(1)) if m else -1  # legacy timestamp names sort first
 
 
-def save(state, directory: str | os.PathLike = "checkpoints", name: str | None = None) -> Path | None:
+# ---------------------------------------------------------------------------
+# Integrity + resume-metadata sidecars (round 9).
+# ---------------------------------------------------------------------------
+
+
+def checksum_sidecar(path: str | os.PathLike) -> Path:
+    """The sha256 sidecar next to a consolidated checkpoint file. (Sharded
+    directories carry their checksums inside manifest.json instead.)"""
+    path = Path(path)
+    return path.with_name(path.name + ".sha256")
+
+
+def meta_path(path: str | os.PathLike) -> Path:
+    """The resume-metadata sidecar: `<file>.meta.json` for a consolidated
+    checkpoint, `resume.json` inside a sharded directory."""
+    path = Path(path)
+    if path.suffix == ".sharded" or path.is_dir():
+        return path / "resume.json"
+    return path.with_name(path.name + ".meta.json")
+
+
+def read_meta(path: str | os.PathLike) -> dict | None:
+    """The save-time metadata (epoch, batch position, preempted flag), or
+    None for checkpoints without it (pre-round-9, or foreign writers)."""
+    try:
+        return json.loads(meta_path(path).read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def _sha256_bytes(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _sha256_file(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _atomic_write_text(path: Path, text: str) -> None:
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)
+
+
+def _publish_sidecars(path: Path, digest: str, meta: dict | None) -> None:
+    """Checksum + metadata sidecars for a just-published consolidated
+    checkpoint. Written AFTER the blob publish: a crash in between leaves
+    a blob without a sidecar, which verification treats as legacy-
+    unverified (accepted with a warning) — never a false corruption."""
+    _atomic_write_text(checksum_sidecar(path), digest)
+    if meta is not None:
+        _atomic_write_text(meta_path(path), json.dumps(meta))
+
+
+def verify_checkpoint(path: str | os.PathLike) -> tuple[bool, str]:
+    """Integrity check of either format. Returns (ok, detail).
+
+    Consolidated: the file's sha256 must match its sidecar; a missing
+    sidecar is accepted as "unverified legacy" (pre-round-9 checkpoints
+    remain restorable) but a PRESENT, mismatching one fails. Sharded: the
+    manifest must exist/parse, every shard file of the manifest's world
+    must exist, and (when the manifest records `checksums`) each shard
+    file's sha256 must match.
+
+    Never raises on I/O: a candidate can VANISH mid-verification (a
+    lagging rank's `latest_good` scan races process 0's quarantine
+    renames during a collective rollback), and the warn-and-skip contract
+    demands (False, detail) — not an unclassified crash that strands the
+    other ranks in the rollback collectives.
+    """
+    path = Path(path)
+    if path.is_dir():
+        try:
+            manifest = json.loads((path / "manifest.json").read_text())
+        except (OSError, ValueError) as exc:
+            return False, f"missing/unreadable manifest ({exc})"
+        shard_files = [
+            path / f"shard-{pid:05d}.npz" for pid in range(manifest.get("nprocs", 0))
+        ]
+        missing = [f.name for f in shard_files if not f.exists()]
+        if missing:
+            return False, f"missing shard files {missing}"
+        checksums = manifest.get("checksums")
+        if checksums is None:
+            return True, "unverified (manifest has no checksums; legacy)"
+        for f in shard_files:
+            want = checksums.get(f.name)
+            if want is None:
+                return False, f"manifest has no checksum for {f.name}"
+            try:
+                got = _sha256_file(f)
+            except OSError as exc:
+                return False, f"unreadable shard {f.name} ({exc})"
+            if got != want:
+                return False, f"checksum mismatch in {f.name}"
+        return True, "verified"
+    if not path.exists():
+        return False, "missing file"
+    side = checksum_sidecar(path)
+    if not side.exists():
+        return True, "unverified (no checksum sidecar; legacy)"
+    try:
+        want = side.read_text().strip()
+    except OSError as exc:
+        return False, f"unreadable checksum sidecar ({exc})"
+    try:
+        got = _sha256_file(path)
+    except OSError as exc:
+        return False, f"unreadable checkpoint ({exc})"
+    if got != want:
+        return False, "checksum mismatch"
+    return True, "verified"
+
+
+def _warn_skip(path: Path, detail: str) -> None:
+    warnings.warn(
+        f"skipping corrupt checkpoint {path}: {detail} — resuming from the "
+        f"next older good one instead",
+        stacklevel=3,
+    )
+
+
+def _write_blob(path: Path, blob: bytes) -> None:
+    """The retried unit of a consolidated write: atomic tmp+rename. The
+    chaos hook sits INSIDE so an injected transient IOError exercises the
+    real retry, not a wrapper around it."""
+    chaos_lib.maybe_io_fault("ckpt_write")
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_bytes(blob)
+    tmp.rename(path)  # atomic publish: no torn checkpoints on crash
+
+
+def _read_blob(path: Path) -> bytes:
+    chaos_lib.maybe_io_fault("ckpt_read")
+    return Path(path).read_bytes()
+
+
+def save(
+    state,
+    directory: str | os.PathLike = "checkpoints",
+    name: str | None = None,
+    meta: dict | None = None,
+) -> Path | None:
     """Consolidate + write the train state. Returns the path (process 0) or
     None (other processes). Safe to call from all processes — the gather is
     collective, the write is process-0-only."""
@@ -72,9 +243,8 @@ def save(state, directory: str | os.PathLike = "checkpoints", name: str | None =
         name += ".msgpack"
     path = directory / name
     blob = serialization.to_bytes(host_state)
-    tmp = path.with_suffix(path.suffix + ".tmp")
-    tmp.write_bytes(blob)
-    tmp.rename(path)  # atomic publish: no torn checkpoints on crash
+    retry_io(_write_blob, path, blob, label="ckpt_write")
+    _publish_sidecars(path, _sha256_bytes(blob), meta)
     return path
 
 
@@ -120,7 +290,7 @@ def restore(template, path: str | os.PathLike):
     sharding). Leaf shapes are validated against the template — flax's
     from_bytes silently accepts mismatched array shapes in plain pytrees,
     which would surface later as an opaque jit/sharding error."""
-    blob = Path(path).read_bytes()
+    blob = retry_io(_read_blob, Path(path), label="ckpt_read")
     try:
         restored = serialization.from_bytes(template, blob)
     except ValueError as exc:
@@ -146,14 +316,24 @@ def restore(template, path: str | os.PathLike):
     return jax.tree_util.tree_unflatten(r_def, out) if changed else restored
 
 
-def latest(directory: str | os.PathLike = "checkpoints") -> Path | None:
+def latest(directory: str | os.PathLike = "checkpoints", verify: bool = True) -> Path | None:
+    """Newest consolidated checkpoint that passes integrity verification
+    (corrupt ones are skipped with a warning; `verify=False` restores the
+    raw newest-by-step behavior)."""
     directory = Path(directory)
     if not directory.is_dir():
         return None
     candidates = sorted(
         directory.glob("checkpoint-*.msgpack"), key=lambda p: (_step_of(p), p.name)
     )
-    return candidates[-1] if candidates else None
+    for path in reversed(candidates):
+        if not verify:
+            return path
+        ok, detail = verify_checkpoint(path)
+        if ok:
+            return path
+        _warn_skip(path, detail)
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -183,6 +363,7 @@ def save_auto(
     directory: str | os.PathLike = "checkpoints",
     name: str | None = None,
     format: str = "auto",
+    meta: dict | None = None,
 ) -> Path | None:
     """Write `state` in the right format. `format`: "auto" (sharded exactly
     when consolidation is impossible), "consolidated", or "sharded".
@@ -191,18 +372,56 @@ def save_auto(
     if format == "auto":
         format = "sharded" if needs_sharded(state) else "consolidated"
     if format == "sharded":
-        return save_sharded(state, directory, name)
+        return save_sharded(state, directory, name, meta=meta)
     if format == "consolidated":
-        return save(state, directory, name)
+        return save(state, directory, name, meta=meta)
     raise ValueError(f"format must be auto|consolidated|sharded, got {format!r}")
 
 
-def latest_any(directory: str | os.PathLike = "checkpoints") -> Path | None:
-    """The newest checkpoint of either format, by training step."""
-    candidates = [p for p in (latest(directory), latest_sharded(directory)) if p]
+def latest_any(
+    directory: str | os.PathLike = "checkpoints", verify: bool = True
+) -> Path | None:
+    """The newest (integrity-verified) checkpoint of either format, by
+    training step."""
+    candidates = [
+        p
+        for p in (latest(directory, verify), latest_sharded(directory, verify))
+        if p
+    ]
     if not candidates:
         return None
     return max(candidates, key=lambda p: (_step_of(p), p.name))
+
+
+def all_checkpoints(directory: str | os.PathLike = "checkpoints") -> list[Path]:
+    """Every published checkpoint of either format, ascending by step
+    (no integrity filtering — callers verify what they restore)."""
+    directory = Path(directory)
+    if not directory.is_dir():
+        return []
+    out = list(directory.glob("checkpoint-*.msgpack"))
+    out += [
+        p for p in directory.glob("*.sharded")
+        if p.is_dir() and (p / "manifest.json").exists()
+    ]
+    return sorted(out, key=lambda p: (_step_of(p), p.name))
+
+
+def latest_good(
+    directory: str | os.PathLike = "checkpoints", max_step: int | None = None
+) -> Path | None:
+    """The newest integrity-verified checkpoint with step <= `max_step`
+    (the rollback target: "last good" means verified AND strictly older
+    than the anomaly's detection window). Corrupt candidates are skipped
+    with a warning."""
+    for path in reversed(all_checkpoints(directory)):
+        if max_step is not None and _step_of(path) > max_step:
+            continue
+        ok, detail = verify_checkpoint(path)
+        if ok:
+            return path
+        _warn_skip(path, detail)
+    return None
 
 
 def restore_any(path: str | os.PathLike, template, sharding_tree=None):
@@ -273,7 +492,69 @@ def _shard_blocks(state, copy: bool = False):
     return blocks, manifest
 
 
-def save_sharded(state, directory: str | os.PathLike = "checkpoints", name: str | None = None) -> Path:
+def _write_shard(final: Path, blocks) -> None:
+    """The retried unit of one shard write: savez to a `.part` then atomic
+    rename, so a shard file never exists half-written under its final
+    name. Chaos hook inside (the retry must cover the injected fault)."""
+    import numpy as np
+
+    chaos_lib.maybe_io_fault("ckpt_write")
+    part = final.with_suffix(final.suffix + ".part")
+    with open(part, "wb") as f:
+        np.savez(f, **blocks)
+    os.replace(part, final)
+
+
+def _write_shard_digest(shard: Path) -> None:
+    """Each rank hashes the shard it JUST wrote (bytes still in page
+    cache — a local re-read, not a network one) and publishes a tiny
+    digest sidecar, so process 0's manifest never has to pull every
+    host's full shard back over the shared filesystem inside the save's
+    critical section."""
+    chaos_lib.maybe_io_fault("ckpt_write")
+    _atomic_write_text(
+        shard.with_name(shard.name + ".sha256"), _sha256_file(shard)
+    )
+
+
+def _finalize_manifest(tmp: Path, manifest: dict, meta: dict | None) -> None:
+    """Process-0 tail of a sharded save, once every shard file exists:
+    fold each rank's published shard digest into the manifest (the
+    integrity contract `verify_checkpoint` checks at restore/rollback
+    time), then write the manifest and the optional resume metadata."""
+
+    def _digest(f: Path) -> str:
+        side = f.with_name(f.name + ".sha256")
+
+        def read() -> str:
+            chaos_lib.maybe_io_fault("ckpt_read")
+            return side.read_text().strip()
+
+        try:
+            return retry_io(read, label="ckpt_read")
+        except OSError:
+            return _sha256_file(f)  # sidecar lost: hash the shard itself
+
+    manifest = dict(manifest)
+    manifest["checksums"] = {
+        f.name: _digest(f) for f in sorted(tmp.glob("shard-*.npz"))
+    }
+
+    def write() -> None:
+        chaos_lib.maybe_io_fault("ckpt_write")
+        _atomic_write_text(tmp / "manifest.json", json.dumps(manifest))
+
+    retry_io(write, label="ckpt_write")
+    if meta is not None:
+        _atomic_write_text(tmp / "resume.json", json.dumps(meta))
+
+
+def save_sharded(
+    state,
+    directory: str | os.PathLike = "checkpoints",
+    name: str | None = None,
+    meta: dict | None = None,
+) -> Path:
     """Write a sharded checkpoint. Every process participates; returns the
     checkpoint directory. Atomic publish: everything is written into a
     `.tmp` directory that process 0 renames only after all processes have
@@ -285,10 +566,6 @@ def save_sharded(state, directory: str | os.PathLike = "checkpoints", name: str 
     process-0 rename (the same contract as torch.distributed checkpoint
     dirs). On host-local paths each host would publish only its own shards.
     """
-    import json
-
-    import numpy as np
-
     # Deterministic name (ADVICE r2): derived from the replicated step, never
     # per-process wall clock — all processes must agree on the directory.
     base = Path(directory).resolve() / ((name or step_name(state)) + ".sharded")
@@ -309,12 +586,15 @@ def save_sharded(state, directory: str | os.PathLike = "checkpoints", name: str 
     sync_global_devices("sharded_ckpt_mkdir")
 
     blocks, manifest = _shard_blocks(state)
-    np.savez(tmp / f"shard-{jax.process_index():05d}.npz", **blocks)
+    shard = tmp / f"shard-{jax.process_index():05d}.npz"
+    retry_io(_write_shard, shard, blocks, label="ckpt_write")
+    retry_io(_write_shard_digest, shard, label="ckpt_write")
 
-    if is_process_zero():
-        (tmp / "manifest.json").write_text(json.dumps(manifest))
+    # barrier BEFORE the manifest: its checksums fold every process's
+    # shard digest, so all shard+digest writes must be durable first
     sync_global_devices("sharded_ckpt_written")
     if is_process_zero():
+        _finalize_manifest(tmp, manifest, meta)
         if not base.exists():
             tmp.rename(base)  # atomic publish
         elif name is None:
@@ -337,6 +617,12 @@ def save_sharded(state, directory: str | os.PathLike = "checkpoints", name: str 
                 stacklevel=2,
             )
             shutil.rmtree(tmp)
+            if meta is not None:
+                # The kept directory holds the same state bytes, but the
+                # caller's resume metadata (a preemption's epoch/batch
+                # position) must still land — dropping it would turn a
+                # mid-epoch resume into a restart of the epoch.
+                _atomic_write_text(base / "resume.json", json.dumps(meta))
         else:
             # Explicitly named re-save: the caller is deliberately reusing a
             # name with (possibly) new contents — swap the fresh data in.
@@ -373,7 +659,9 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
     import numpy as np
 
     base = Path(path)
-    manifest = json.loads((base / "manifest.json").read_text())
+    manifest = json.loads(
+        retry_io(_read_blob, base / "manifest.json", label="ckpt_read")
+    )
     # Exactly the files the manifest's world wrote — a stale extra
     # shard-*.npz (e.g. from a crashed save under a different world size,
     # on a filesystem where the pre-save cleanup could not see it) must not
@@ -388,7 +676,53 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
             f"{manifest['nprocs']} processes; are all shard files on this "
             f"filesystem?)"
         )
-    archives = [np.load(f) for f in shard_files]
+    class _Shard:
+        # One lazy NpzFile handle per shard (zip metadata only — an eager
+        # whole-shard read would hold the entire checkpoint in host RAM on
+        # every process), with every deferred block read wrapped in
+        # retry_io: a failed read drops the handle so the retry reopens
+        # from a clean zip state instead of a poisoned stream position.
+        def __init__(self, f):
+            self.f = f
+            self._npz = None
+            self._files = None
+
+        def _open(self):
+            chaos_lib.maybe_io_fault("ckpt_read")
+            if self._npz is None:
+                self._npz = np.load(self.f)
+            return self._npz
+
+        def close(self):
+            if self._npz is not None:
+                try:
+                    self._npz.close()
+                except Exception:
+                    pass
+                self._npz = None
+
+        def files(self):
+            if self._files is None:
+
+                def _list():
+                    try:
+                        return list(self._open().files)
+                    except OSError:
+                        self.close()
+                        raise
+
+                self._files = retry_io(_list, label="ckpt_read")
+            return self._files
+
+        def read(self, key):
+            def _read():
+                try:
+                    return self._open()[key]
+                except OSError:
+                    self.close()
+                    raise
+
+            return retry_io(_read, label="ckpt_read")
 
     flat, treedef = jax.tree_util.tree_flatten(template)
     if sharding_tree is None:
@@ -403,6 +737,7 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
             f"{len(manifest['leaves'])} ({base})"
         )
 
+    readers = [_Shard(f) for f in shard_files]
     restored = []
     for i, (leaf, meta, sharding) in enumerate(zip(flat, manifest["leaves"], shardings)):
         shape, dtype = tuple(meta["shape"]), np.dtype(meta["dtype"])
@@ -410,12 +745,12 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
         full = np.empty(shape, dtype)
         covered = 0  # blocks are disjoint by construction (replica_id==0)
         prefix = f"{i}|"
-        for ar in archives:
-            for key in ar.files:
+        for ar in readers:
+            for key in ar.files():
                 if not key.startswith(prefix):
                     continue
+                block = ar.read(key)
                 starts_s = key[len(prefix):]
-                block = ar[key]
                 if starts_s:
                     starts = [int(s) for s in starts_s.split(",")]
                     idx = tuple(
@@ -448,6 +783,8 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
             )
         else:
             restored.append(_as_jax_array(full))
+    for ar in readers:
+        ar.close()  # error paths are fatal; GC closes leaked handles
     return jax.tree_util.tree_unflatten(treedef, restored)
 
 
@@ -474,18 +811,19 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
 # ---------------------------------------------------------------------------
 
 
-def _write_consolidated_blob(host_state, path: Path) -> None:
+def _write_consolidated_blob(host_state, path: Path, meta: dict | None = None) -> None:
     """Background half of an async consolidated save: encode + atomic write
-    of an already-snapshotted host pytree. Pure host work."""
+    of an already-snapshotted host pytree (same retry + integrity-sidecar
+    contract as the sync writer). Pure host work."""
     path.parent.mkdir(parents=True, exist_ok=True)
     blob = serialization.to_bytes(host_state)
-    tmp = path.with_suffix(path.suffix + ".tmp")
-    tmp.write_bytes(blob)
-    tmp.rename(path)  # atomic publish: no torn checkpoints on crash
+    retry_io(_write_blob, path, blob, label="ckpt_write")
+    _publish_sidecars(path, _sha256_bytes(blob), meta)
 
 
 def _publish_sharded_snapshot(
-    blocks, manifest, base: Path, timeout: float = 600.0
+    blocks, manifest, base: Path, timeout: float = 600.0,
+    meta: dict | None = None,
 ) -> None:
     """Background half of an async sharded save: write this process's shard
     atomically, then (process 0) wait for every process's shard file and
@@ -507,20 +845,19 @@ def _publish_sharded_snapshot(
     only warns about: reusing an old checkpoints dir across runs with
     DIFFERENT config/data, where a same-step stale shard could win — fresh
     runs must start with a clean checkpoints dir."""
-    import json
-
-    import numpy as np
-
     if base.exists():
-        return  # same-step re-save: already durable (see docstring)
+        # Same-step re-save: already durable (see docstring) — but the
+        # caller's resume metadata (a preemption's epoch/batch position)
+        # must still land in the kept directory.
+        if meta is not None and is_process_zero():
+            _atomic_write_text(base / "resume.json", json.dumps(meta))
+        return
     tmp = base.with_name(base.name + ".tmp")
     tmp.mkdir(parents=True, exist_ok=True)
     pid = jax.process_index()
-    final = tmp / f"shard-{pid:05d}.npz"
-    part = tmp / f"shard-{pid:05d}.npz.part"
-    with open(part, "wb") as f:
-        np.savez(f, **blocks)
-    part.rename(final)  # atomic: a half-written shard never looks complete
+    shard = tmp / f"shard-{pid:05d}.npz"
+    retry_io(_write_shard, shard, blocks, label="ckpt_write")
+    retry_io(_write_shard_digest, shard, label="ckpt_write")
     deadline = time.monotonic() + timeout
     if not is_process_zero():
         # Publish barrier for every process: wait() on ANY host must mean
@@ -536,7 +873,11 @@ def _publish_sharded_snapshot(
                 )
             time.sleep(0.05)
         return
-    expected = [tmp / f"shard-{p:05d}.npz" for p in range(manifest["nprocs"])]
+    expected = [
+        tmp / name
+        for p in range(manifest["nprocs"])
+        for name in (f"shard-{p:05d}.npz", f"shard-{p:05d}.npz.sha256")
+    ]
     while True:
         missing = [str(p.name) for p in expected if not p.exists()]
         if not missing:
@@ -548,10 +889,7 @@ def _publish_sharded_snapshot(
                 f"directory on a filesystem shared by all hosts?)"
             )
         time.sleep(0.05)
-    mpath = tmp / "manifest.json"
-    mtmp = tmp / "manifest.json.part"
-    mtmp.write_text(json.dumps(manifest))
-    mtmp.rename(mpath)
+    _finalize_manifest(tmp, manifest, meta)
     if not base.exists():
         tmp.rename(base)  # atomic publish
 
@@ -599,6 +937,7 @@ class AsyncCheckpointer:
         directory: str | os.PathLike = "checkpoints",
         name: str | None = None,
         format: str = "auto",
+        meta: dict | None = None,
     ) -> Path | None:
         """Async twin of module-level `save_auto` (same routing, same return
         convention). Blocks only for the previous write's join barrier plus
@@ -623,7 +962,9 @@ class AsyncCheckpointer:
             if not nm.endswith(".msgpack"):
                 nm += ".msgpack"
             path = Path(directory).resolve() / nm
-            work = functools.partial(_write_consolidated_blob, host_state, path)
+            work = functools.partial(
+                _write_consolidated_blob, host_state, path, meta
+            )
         elif format == "sharded":
             blocks, manifest = _shard_blocks(state, copy=True)
             path = Path(directory).resolve() / (
@@ -631,7 +972,7 @@ class AsyncCheckpointer:
             )
             work = functools.partial(
                 _publish_sharded_snapshot, blocks, manifest, path,
-                self._shard_timeout,
+                self._shard_timeout, meta,
             )
         else:
             raise ValueError(
@@ -651,12 +992,28 @@ class AsyncCheckpointer:
         return path
 
 
-def latest_sharded(directory: str | os.PathLike = "checkpoints") -> Path | None:
+def latest_sharded(
+    directory: str | os.PathLike = "checkpoints", verify: bool = True
+) -> Path | None:
+    """Newest sharded checkpoint that passes integrity verification —
+    a directory missing its manifest (a torn publish) is invisible here by
+    construction; one with a checksum-mismatching or missing shard file is
+    skipped with a warning."""
     directory = Path(directory)
     if not directory.is_dir():
         return None
     candidates = sorted(
-        p for p in directory.glob("*.sharded")
-        if p.is_dir() and (p / "manifest.json").exists()
+        (
+            p for p in directory.glob("*.sharded")
+            if p.is_dir() and (p / "manifest.json").exists()
+        ),
+        key=lambda p: (_step_of(p), p.name),
     )
-    return candidates[-1] if candidates else None
+    for path in reversed(candidates):
+        if not verify:
+            return path
+        ok, detail = verify_checkpoint(path)
+        if ok:
+            return path
+        _warn_skip(path, detail)
+    return None

@@ -113,6 +113,26 @@ class TrainFlags:
     # process 0 compare across processes; a mismatch at the same step
     # logs kind="divergence" and dumps a bundle. 0 disables.
     divergence_check_freq: int = 0
+    # Recovery (round 9, docs/DESIGN.md "recovery"). --on_anomaly rollback
+    # turns a sentinel/divergence firing from checkpoint-then-abort into an
+    # in-process rollback: restore the last integrity-verified checkpoint
+    # OLDER than the detection window, keep the input stream moving forward
+    # (the offending batch window is never replayed), and continue — up to
+    # --max_rollbacks times, then escalate to the round-8 bundle-dump-and-
+    # abort path (exit code 77). "none" keeps the round-8 behavior.
+    on_anomaly: str = "none"  # none | rollback
+    max_rollbacks: int = 3
+    # Transient host-I/O retry budget (tpukit/retry.py): checkpoint
+    # reads/writes and dataset fetches retry up to N times with jittered
+    # exponential backoff before failing loud. Every retry leaves a
+    # kind="retry" JSONL record. 0 disables retrying.
+    io_retries: int = 3
+    # Deterministic fault injection (tpukit/chaos.py), e.g.
+    # "nan_loss@120,sigterm@300,ckpt_io_fail@2,hang@450:2.5" — see the
+    # chaos-spec grammar in docs/DESIGN.md. Empty = no harness installed;
+    # the compiled train step is byte-identical either way (all injection
+    # is host-side).
+    chaos_spec: str = ""
     # Rematerialization policy: checkpoint each decoder layer (backward
     # recomputes the layer forward; less HBM traffic and memory — needed for
     # the larger ladder configs at long sequence).
@@ -219,6 +239,14 @@ def build_parser(
         "--divergence_check_freq", type=int,
         default=defaults.divergence_check_freq,
     )
+    parser.add_argument(
+        "--on_anomaly", choices=("none", "rollback"), default=defaults.on_anomaly
+    )
+    parser.add_argument(
+        "--max_rollbacks", type=int, default=defaults.max_rollbacks
+    )
+    parser.add_argument("--io_retries", type=int, default=defaults.io_retries)
+    parser.add_argument("--chaos_spec", type=str, default=defaults.chaos_spec)
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--scan_layers", action="store_true")
     parser.add_argument("--microbatches", type=int, default=defaults.microbatches)

@@ -24,7 +24,9 @@ from typing import Iterator
 
 import numpy as np
 
+from tpukit import chaos as chaos_lib
 from tpukit.data import ArrayDataset
+from tpukit.retry import retry_io
 
 
 def distributed_indices(
@@ -252,14 +254,23 @@ class DataLoader:
             self.rank = prev_rank
         return totals if totals is not None else np.zeros(0, dtype=np.int64)
 
+    def _fetch_rows(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """One batch's dataset fetch — the retried I/O unit. For the
+        in-memory fixture this is a numpy gather; for disk/remote-backed
+        datasets (HF arrow on NFS/GCS-fuse) it is real I/O whose transient
+        failures the round-9 backoff wrapper absorbs. The chaos hook sits
+        inside so `loader_io_fail@K` exercises the actual retry path."""
+        chaos_lib.maybe_io_fault("loader_fetch")
+        safe = np.maximum(idx, 0)
+        return self.dataset.input_ids[safe], self.dataset.attention_mask[safe]
+
     def __iter__(self) -> Iterator[dict]:
         indices, real = self._indices()
         n = len(indices)
         stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
         for start in range(0, stop, self.batch_size):
             idx = indices[start : start + self.batch_size]
-            ids = self.dataset.input_ids[np.maximum(idx, 0)]
-            mask = self.dataset.attention_mask[np.maximum(idx, 0)]
+            ids, mask = retry_io(self._fetch_rows, idx, label="loader_fetch")
             pad_rows = idx < 0  # -1 sentinels become all-ignore rows
             if pad_rows.any():
                 ids = np.where(pad_rows[:, None], self.pad_fill, ids)

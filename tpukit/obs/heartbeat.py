@@ -71,13 +71,20 @@ class Heartbeat:
         now: float | None = None,
         checksum: str | None = None,
         checksum_step: int | None = None,
+        timeline: int = 0,
     ) -> None:
         """Write this process's liveness record (atomic replace).
 
         `checksum`/`checksum_step` (divergence detection, obs/divergence.py)
         piggyback the latest state checksum on the existing liveness file so
         the cross-process comparison needs no new rendezvous: process 0
-        already reads every beat each window."""
+        already reads every beat each window.
+
+        `timeline` (round-9 rollback) counts the collective rollbacks this
+        process has executed. Divergence comparison only matches checksums
+        from the SAME timeline: after a rollback, step numbers repeat with
+        different data, so a stale pre-rollback checksum at an equal step
+        number must never be compared against a post-rollback one."""
         now = time.time() if now is None else now
         if self._last_beat is not None:
             self._cadence = now - self._last_beat
@@ -87,6 +94,8 @@ class Heartbeat:
             "step": int(step),
             "time": now,
         }
+        if timeline:
+            rec["timeline"] = int(timeline)
         if checksum is not None:
             rec["checksum"] = checksum
             rec["checksum_step"] = int(
@@ -149,26 +158,31 @@ class Heartbeat:
     def check_divergence(self) -> list[dict]:
         """Cross-replica checksum comparison (run on process 0 each window).
 
-        Groups the beat files' `checksum` values by `checksum_step` and
-        compares only beats taken at the SAME step — processes mid-window
-        skew (one already past the next check step) are simply not compared
-        yet, so skew can never produce a false positive. At any step where
-        more than one distinct checksum exists, the minority processes are
-        reported against the majority value (ties break deterministically
-        by checksum string). Returns one record per diverged process:
+        Groups the beat files' `checksum` values by (timeline,
+        `checksum_step`) and compares only beats taken at the SAME step of
+        the SAME rollback timeline — processes mid-window skew (one
+        already past the next check step) are simply not compared yet, so
+        skew can never produce a false positive, and post-rollback
+        re-executed step numbers are never compared against stale
+        pre-rollback beats. At any comparable point where more than one
+        distinct checksum exists, the minority processes are reported
+        against the majority value (ties break deterministically by
+        checksum string). Returns one record per diverged process:
         `{process, checksum_step, checksum, expected}`.
         """
-        by_step: dict[int, dict[str, list[int]]] = {}
+        by_key: dict[tuple[int, int], dict[str, list[int]]] = {}
         for rec in self.read_all().values():
             cs, st = rec.get("checksum"), rec.get("checksum_step")
             if cs is None or st is None:
                 continue
-            by_step.setdefault(int(st), {}).setdefault(str(cs), []).append(
+            key = (int(rec.get("timeline", 0)), int(st))
+            by_key.setdefault(key, {}).setdefault(str(cs), []).append(
                 int(rec["process"])
             )
         out = []
-        for st in sorted(by_step):
-            groups = by_step[st]
+        for key in sorted(by_key):
+            st = key[1]
+            groups = by_key[key]
             if len(groups) < 2:
                 continue
             ranked = sorted(groups.items(), key=lambda kv: (-len(kv[1]), kv[0]))

@@ -87,6 +87,13 @@ class SpikeSentinel:
         self.rel_floor = rel_floor
         self._hist: deque[float] = deque(maxlen=window)
 
+    def reset(self) -> None:
+        """Drop the rolling history (round-9 rollback: after a restore the
+        loss returns to an OLDER point on the curve — judging it against
+        the pre-anomaly baseline would immediately re-fire the sentinel on
+        a perfectly healthy recovery)."""
+        self._hist.clear()
+
     def observe(self, loss: float, step: int) -> SpikeEvent | None:
         loss = float(loss)
         if not math.isfinite(loss):

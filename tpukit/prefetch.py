@@ -34,6 +34,7 @@ processes and deadlock a multi-host program.
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 from typing import Any, Callable, Iterable
@@ -56,12 +57,22 @@ class HostPrefetcher:
         process: Callable[[Any], Any] | None = None,
         depth: int = 2,
         name: str = "tpukit-prefetch",
+        skip: int = 0,
     ):
+        """`skip` drops the first N raw items BEFORE `process` runs (round
+        9: the mid-epoch resume fast-forward) — the skipped batches never
+        pay host prep or H2D placement, and the drop happens on the worker
+        thread, overlapping the restore/compile the training thread is
+        busy with."""
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        if skip < 0:
+            raise ValueError(f"prefetch skip must be >= 0, got {skip}")
         self.depth = depth
+        self._skip = skip
         self._queue: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        self._host_lock = threading.Lock()
         self._exhausted = False
         self._producer_done = False
         # window-resettable occupancy gauge (window_stats): how full the
@@ -88,11 +99,25 @@ class HostPrefetcher:
         return False
 
     def _worker(self, it, process):
+        _end = object()
         try:
+            for _ in range(self._skip):
+                if self._stop.is_set():
+                    return
+                if next(it, _end) is _end:
+                    break  # skipping past the end: the stream is just empty
             for raw in it:
                 if self._stop.is_set():
                     return
-                item = raw if process is None else process(raw)
+                if process is None:
+                    item = raw
+                else:
+                    # serialized against quiesce(): the host pipeline ends
+                    # in device_put, and a training-thread placement (a
+                    # rollback's checkpoint restore) racing it can corrupt
+                    # the runtime — two threads must never place at once
+                    with self._host_lock:
+                        item = process(raw)
                 if not self._put((_ITEM, item)):
                     return
             self._producer_done = True
@@ -133,6 +158,22 @@ class HostPrefetcher:
         the diagnostics-bundle probe; window_stats owns the per-window
         occupancy average)."""
         return self._queue.qsize()
+
+    @contextlib.contextmanager
+    def quiesce(self):
+        """Hold the worker between host-pipeline items while the body runs
+        (an in-flight item completes first — the acquire waits for it).
+
+        Round 9: a rollback restores a checkpoint MID-stream, and its
+        training-thread `device_put`s racing the worker's batch placement
+        segfault the CPU runtime (observed on jax 0.4.x; resume-time
+        restores never raced because they run before the first prefetcher
+        exists). Any other training-thread placement concurrent with a
+        live prefetcher needs the same bracket. The buffer keeps serving
+        already-prepared batches throughout — quiesce pauses production,
+        not consumption."""
+        with self._host_lock:
+            yield
 
     def window_stats(self) -> dict:
         """Mean buffer occupancy since the last call (the per-window JSONL

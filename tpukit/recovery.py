@@ -1,0 +1,551 @@
+"""The recovery engine: exit-code contract, preemption, in-process rollback.
+
+Rounds 6-8 gave tpukit detection for every major failure class (loss
+spike/NaN sentinels, hang watchdog, heartbeat stragglers, cross-replica
+divergence checksums) — but the only RESPONSE was checkpoint-then-abort.
+At pod scale preemptions and transient faults are routine; a run that
+aborts on the first anomaly wastes the whole fleet. Round 9 closes the
+detect→recover loop with three mechanisms, all wired through `fit()`:
+
+**Exit-code contract** (asserted by the kill-midrun harness, documented
+in README): a training process exits
+
+    0   (EXIT_CLEAN)               schedule completed, final checkpoint durable
+    75  (EXIT_PREEMPTED)           SIGTERM/SIGINT received; a final
+                                   checkpoint WAS written — relaunch with
+                                   `--resume latest` continues bit-exact
+    76  (EXIT_ANOMALY_ABORT)       sentinel abort (--spike_action abort):
+                                   blown-up state checkpointed + bundle dumped
+    77  (EXIT_ROLLBACK_EXHAUSTED)  --on_anomaly rollback ran out of budget
+                                   (or had no restorable checkpoint) and
+                                   escalated to the bundle-dump-and-abort path
+
+75 is EX_TEMPFAIL — the sysexits meaning ("temporary failure, retry
+later") matches exactly: the babysitter/scheduler should reschedule with
+`--resume latest`. 76/77 mean "do NOT blindly restart: a human or a
+triage bot should read the bundle first".
+
+**Preemption** (`PreemptionGuard`): SIGTERM/SIGINT set a flag from the
+signal handler (nothing else is async-signal-safe); the training loop
+polls it at each iteration boundary and performs a GRACEFUL exit —
+durable checkpoint (with resume metadata: epoch + batch position, so
+`--resume latest` continues mid-epoch bit-exact), heartbeat update,
+`kind="preempt"` JSONL record, then `Preempted` unwinds to the recipe
+entry point which maps it to exit code 75.
+
+**Rollback** (`RecoveryEngine`, `--on_anomaly rollback`): when a sentinel
+or divergence check fires, instead of aborting the trainer restores the
+last *integrity-verified* checkpoint strictly OLDER than the anomaly's
+detection window (a checkpoint saved inside the window may already hold
+the poisoned state), in process — no scheduler round-trip, no recompile
+(the jitted step functions survive). The input stream is NOT rewound: the
+loader/prefetcher keeps streaming forward, so the offending batch window
+is never replayed (a deterministic bad batch would otherwise re-kill the
+run on every attempt). Checkpoints from the abandoned timeline segment
+are quarantined (renamed aside) so a later `latest`/rollback can never
+resurrect suspect state. The budget (`--max_rollbacks`) bounds the loop;
+exhaustion escalates to the round-8 bundle-dump-and-abort path with exit
+code 77.
+
+**Collective decision** (multi-process worlds): all processes must roll
+back to the same step or the pod deadlocks in mismatched collectives.
+Sentinel anomalies are detected by every process in lockstep (the window
+loss is replicated), so each process computes the same plan locally;
+process 0 additionally publishes the decision record through the
+heartbeat directory (`rollback-<seq>.json`) and every other process
+CONFIRMS its local plan against it before restoring — a bounded wait,
+failing loud on mismatch or timeout. Divergence anomalies are detected
+by process 0 only; its decision file is published one window AHEAD of
+execution (`execute_after`), and every process (p0 included) executes it
+at the first window boundary past that step — one window of file
+propagation time on the shared filesystem, with the heartbeat timeline
+counter keeping stale pre-rollback checksums out of post-rollback
+divergence comparisons.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+from tpukit import checkpoint as ckpt_lib
+
+# ---------------------------------------------------------------------------
+# Exit-code contract
+# ---------------------------------------------------------------------------
+
+EXIT_CLEAN = 0
+EXIT_PREEMPTED = 75  # EX_TEMPFAIL: checkpointed, relaunch with --resume latest
+EXIT_ANOMALY_ABORT = 76
+EXIT_ROLLBACK_EXHAUSTED = 77
+
+
+def _atomic_write_json(path: Path, obj: dict) -> None:
+    """Atomic tmp+replace publish of one coordination record — a reader
+    polling the shared directory sees the whole record or nothing. (One
+    atomic-publish rule for the whole package: checkpoint.py's helper.)"""
+    ckpt_lib._atomic_write_text(path, json.dumps(obj))
+
+
+class TrainingAborted(RuntimeError):
+    """Base of every deliberate abnormal training exit; `exit_code` is the
+    process exit status the recipe entry point maps it to."""
+
+    exit_code = 1
+
+
+class AnomalyAbort(TrainingAborted):
+    """Sentinel abort (--spike_action abort): state checkpointed for
+    autopsy, diagnostics bundle dumped, then raised."""
+
+    exit_code = EXIT_ANOMALY_ABORT
+
+
+class RollbackBudgetExhausted(AnomalyAbort):
+    """--on_anomaly rollback escalated: the budget is spent (or no
+    integrity-verified checkpoint exists to restore)."""
+
+    exit_code = EXIT_ROLLBACK_EXHAUSTED
+
+
+class Preempted(TrainingAborted):
+    """SIGTERM/SIGINT handled gracefully: a final checkpoint was written;
+    `--resume latest` continues the run."""
+
+    exit_code = EXIT_PREEMPTED
+
+    def __init__(self, message: str, checkpoint: Any = None, step: int = 0):
+        super().__init__(message)
+        self.checkpoint = checkpoint
+        self.step = step
+
+
+def run_recipe(main_fn: Callable, argv=None) -> int:
+    """Recipe entry-point wrapper mapping the exceptions above onto the
+    documented exit codes (`sys.exit(run_recipe(main))`). Anything else
+    propagates — an unexpected crash must keep its traceback and its
+    nonzero (unclassified) exit status."""
+    import sys
+
+    try:
+        main_fn(argv)
+        return EXIT_CLEAN
+    except TrainingAborted as exc:
+        print(f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return exc.exit_code
+
+
+# ---------------------------------------------------------------------------
+# Preemption
+# ---------------------------------------------------------------------------
+
+
+class PreemptionGuard:
+    """SIGTERM/SIGINT → a polled flag. The handler only sets state (the
+    async-signal-safe discipline); the training loop polls `pending` at
+    iteration boundaries and runs the graceful checkpoint-and-exit path
+    itself, on the training thread, where device state is coherent.
+
+    Installed for the duration of one fit() (context manager restores the
+    previous handlers — nested/test usage must not leak). Handlers can
+    only be installed on the main thread; elsewhere the guard degrades to
+    an inert flag (chaos `sigterm@N` still works there via the default
+    handler only, so tests run fit on the main thread).
+
+    A SECOND signal while the graceful path runs restores the previous
+    handler and re-raises it — the escape hatch when the final checkpoint
+    itself wedges and the scheduler escalates to SIGKILL anyway.
+    """
+
+    SIGNALS = ("SIGTERM", "SIGINT")
+
+    def __init__(self):
+        self._pending: str | None = None
+        self._prev: dict[int, Any] = {}
+        self._installed = False
+
+    @property
+    def pending(self) -> str | None:
+        return self._pending
+
+    def _handler(self, signum, frame):
+        name = signal.Signals(signum).name
+        if self._pending is not None:
+            # second signal: stop being graceful
+            self._restore()
+            signal.raise_signal(signum)
+            return
+        self._pending = name
+
+    def __enter__(self):
+        if threading.current_thread() is threading.main_thread():
+            for name in self.SIGNALS:
+                sig = getattr(signal, name)
+                self._prev[sig] = signal.signal(sig, self._handler)
+            self._installed = True
+        return self
+
+    def _restore(self):
+        if self._installed:
+            for sig, prev in self._prev.items():
+                try:
+                    signal.signal(sig, prev)
+                except (ValueError, OSError):  # not main thread / torn down
+                    pass
+            self._installed = False
+
+    def __exit__(self, *exc):
+        self._restore()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Rollback
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RollbackPlan:
+    seq: int  # 1-based rollback counter within the run
+    reason: str
+    anomaly_step: int  # host step at detection (the window boundary)
+    target_step: int  # checkpoint step being restored
+    target_path: str  # checkpoint path (either format)
+    steps_lost: int  # anomaly_step - target_step
+
+    def record(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class RollbackCoordinator:
+    """Decision files in the (shared) heartbeat directory: the on-disk
+    channel making a multi-process rollback collective. Process 0 writes
+    `rollback-<seq>.json` atomically; every process acks with
+    `rollback-<seq>-ack-p<idx>.json`. Single-process worlds never touch
+    the filesystem (`publish`/`confirm` short-circuit)."""
+
+    def __init__(self, directory: str | os.PathLike | None,
+                 process_index: int = 0, process_count: int = 1,
+                 timeout_s: float = 120.0):
+        self.directory = Path(directory) if directory else None
+        self.process_index = process_index
+        self.process_count = process_count
+        self.timeout_s = timeout_s
+        if self.directory is not None and process_count > 1:
+            self.directory.mkdir(parents=True, exist_ok=True)
+            # A relaunched incarnation restarts its seq counter at 1, so a
+            # surviving rollback-0001.json from the PREVIOUS incarnation
+            # would either execute a spurious rollback at the first window
+            # boundary or (via the in-flight dedup) suppress every real
+            # deferred rollback of this run. Process 0 clears the channel
+            # before any rank of the new world can poll it — ranks
+            # construct their coordinators during setup, whole windows
+            # before the first poll.
+            if self.process_index == 0:
+                for stale in self.directory.glob("rollback-*.json"):
+                    stale.unlink(missing_ok=True)
+
+    def _path(self, seq: int) -> Path:
+        return self.directory / f"rollback-{seq:04d}.json"
+
+    def publish(self, plan: RollbackPlan, execute_after: int | None = None) -> None:
+        """Process 0 publishes the decision (atomic tmp+rename)."""
+        if self.directory is None or self.process_count == 1:
+            return
+        rec = plan.record()
+        if execute_after is not None:
+            rec["execute_after"] = execute_after
+        _atomic_write_json(self._path(plan.seq), rec)
+
+    def publish_abort(self, seq: int, reason: str, anomaly_step: int,
+                      execute_after: int) -> None:
+        """Process 0 publishes a collective-ABORT decision (budget spent or
+        nothing restorable on a p0-only anomaly). A lone-process abort would
+        strand the other ranks in the autopsy checkpoint's collective, so
+        every process must reach the abort path at the same boundary —
+        `poll_rollback` executes records carrying `action: "abort"`."""
+        if self.directory is None or self.process_count == 1:
+            return
+        _atomic_write_json(self._path(seq), {
+            "seq": seq, "action": "abort", "reason": reason,
+            "anomaly_step": anomaly_step, "execute_after": execute_after,
+        })
+
+    def read(self, seq: int) -> dict | None:
+        """The decision with sequence number `seq`, if published."""
+        if self.directory is None:
+            return None
+        path = self._path(seq)
+        try:
+            return json.loads(path.read_text())
+        except (OSError, ValueError):
+            return None
+
+    def confirm(self, plan: RollbackPlan) -> None:
+        """Non-zero processes: wait (bounded) for process 0's decision and
+        verify the locally computed plan matches it — a pod must never
+        roll back to two different steps. Raises on timeout/mismatch."""
+        if self.directory is None or self.process_count == 1 or self.process_index == 0:
+            return
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            rec = self.read(plan.seq)
+            if rec is not None:
+                if int(rec["target_step"]) != plan.target_step:
+                    raise TrainingAborted(
+                        f"rollback {plan.seq}: process {self.process_index} "
+                        f"planned target step {plan.target_step} but process "
+                        f"0 decided {rec['target_step']} — refusing a "
+                        f"split-brain rollback"
+                    )
+                return
+            if time.monotonic() > deadline:
+                raise TrainingAborted(
+                    f"rollback {plan.seq}: timed out after {self.timeout_s}s "
+                    f"waiting for process 0's decision file in "
+                    f"{self.directory}"
+                )
+            time.sleep(0.05)
+
+    def ack(self, seq: int, step: int) -> None:
+        if self.directory is None or self.process_count == 1:
+            return
+        _atomic_write_json(
+            self.directory / f"rollback-{seq:04d}-ack-p{self.process_index:05d}.json",
+            {"process": self.process_index, "step": step},
+        )
+
+    # -- final-drain rendezvous --------------------------------------------
+    # A deferred decision published during the run's LAST training window
+    # is executed at the end-of-epoch drain (train.py poll_rollback
+    # final=True) — but "read the decision file once and trust None" races
+    # process 0's publish: p0 detects divergence inside its last boundary
+    # block (heartbeat reads + hashing, slow) while a faster rank has
+    # already left the loop. The marker file closes the race: p0 writes it
+    # only AFTER everything it will ever publish is on disk, and other
+    # ranks must not trust a None read until the marker exists. It lives
+    # in the rollback-*.json namespace so the construction-time sweep
+    # clears a previous incarnation's marker.
+
+    @property
+    def _final_drain_path(self) -> Path:
+        return self.directory / "rollback-final-drain.json"
+
+    def publish_final_drain(self, step: int) -> None:
+        """Process 0, entering the final drain: declare the decision
+        channel complete (any pending decision is already published)."""
+        if self.directory is None or self.process_count == 1 or self.process_index != 0:
+            return
+        _atomic_write_json(self._final_drain_path, {"step": int(step)})
+
+    def wait_final_drain(self) -> None:
+        """Non-zero ranks, entering the final drain: bounded wait for
+        process 0's marker before reading the decision file — a None read
+        before the marker exists proves nothing. Raises on timeout (p0
+        died mid-window; proceeding could eval/save a diverged state)."""
+        if self.directory is None or self.process_count == 1 or self.process_index == 0:
+            return
+        deadline = time.monotonic() + self.timeout_s
+        while not self._final_drain_path.exists():
+            if time.monotonic() > deadline:
+                raise TrainingAborted(
+                    f"final rollback drain: timed out after {self.timeout_s}s "
+                    f"waiting for process 0's final-drain marker in "
+                    f"{self.directory}"
+                )
+            time.sleep(0.05)
+
+
+class PreemptCoordinator:
+    """Decision files making a multi-process preemption checkpoint
+    collective. The graceful save in `check_preempt` is a step-keyed
+    collective write, but each rank polls its signal flag at its own
+    wall-clock — host loops run ahead of the device frontier by up to a
+    window, so two ranks observing the same SIGTERM can sit at different
+    host steps and an uncoordinated save would deadlock the step-keyed
+    rendezvous. Protocol: any rank whose signal lands publishes
+    `preempt-request-p<idx>.json`; process 0 (at a window boundary) turns
+    the first request into `preempt-decision.json` naming a window
+    boundary at least one FULL window ahead; every rank's deterministic
+    host-step counter passes through that boundary's poll exactly once,
+    so all ranks checkpoint at the same step. Single-process worlds never
+    construct this (the uncoordinated path is already correct)."""
+
+    def __init__(self, directory: str | os.PathLike | None,
+                 process_index: int = 0, process_count: int = 1):
+        self.directory = Path(directory) if directory else None
+        self.process_index = process_index
+        self.process_count = process_count
+        self._requested = False
+        # The incarnation tag: fit() sets this to the run's starting
+        # host_step once the (possibly resumed) state is known. Every rank
+        # restores the same checkpoint, so the tag is collective without a
+        # collective; records whose tag mismatches the reader's are stale
+        # leftovers of a previous incarnation and are ignored. This closes
+        # the relaunch race the unlink below cannot: a fast rank's first
+        # poll can happen before a slow p0's init cleanup, and a resumed
+        # run's host_step lands exactly on the stale decision's
+        # execute_after boundary.
+        self.run_start = 0
+        if self.directory is not None and process_count > 1:
+            self.directory.mkdir(parents=True, exist_ok=True)
+            # Hygiene sweep (the tag above is the correctness guard): a
+            # resumed run re-reading stale files would preempt again
+            # WITHOUT any signal — every relaunch exits 75 and the run
+            # never progresses. Each rank clears its own stale request;
+            # process 0 clears the decision.
+            (
+                self.directory
+                / f"preempt-request-p{self.process_index:05d}.json"
+            ).unlink(missing_ok=True)
+            if self.process_index == 0:
+                self._decision_path.unlink(missing_ok=True)
+
+    @property
+    def _decision_path(self) -> Path:
+        return self.directory / "preempt-decision.json"
+
+    def request(self, signal_name: str) -> None:
+        """Publish this rank's pending signal (idempotent, atomic)."""
+        if self.directory is None or self._requested:
+            return
+        _atomic_write_json(
+            self.directory / f"preempt-request-p{self.process_index:05d}.json",
+            {
+                "process": self.process_index, "signal": signal_name,
+                "run_start": self.run_start,
+            },
+        )
+        self._requested = True
+
+    def any_request(self) -> str | None:
+        """Process 0: the signal named by any published request of THIS
+        incarnation (stale tags are skipped, not trusted)."""
+        if self.directory is None:
+            return None
+        for path in sorted(self.directory.glob("preempt-request-p*.json")):
+            try:
+                rec = json.loads(path.read_text())
+                if rec.get("run_start") != self.run_start:
+                    continue  # another incarnation's leftover
+                return rec["signal"]
+            except (OSError, ValueError, KeyError):
+                continue  # racing a partial write: next poll sees it
+        return None
+
+    def publish(self, signal_name: str, execute_after: int) -> dict:
+        """Process 0 publishes the decision (idempotent: first wins)."""
+        existing = self.read()
+        if existing is not None:
+            return existing
+        rec = {
+            "signal": signal_name, "execute_after": int(execute_after),
+            "run_start": self.run_start,
+        }
+        _atomic_write_json(self._decision_path, rec)
+        return rec
+
+    def read(self) -> dict | None:
+        if self.directory is None:
+            return None
+        try:
+            rec = json.loads(self._decision_path.read_text())
+        except (OSError, ValueError):
+            return None
+        if rec.get("run_start") != self.run_start:
+            return None  # another incarnation's leftover decision
+        return rec
+
+
+class RecoveryEngine:
+    """Budgeted in-process rollback over the run's checkpoint directory.
+
+    `plan(reason, anomaly_step, window)` picks the newest
+    integrity-verified checkpoint with step <= anomaly_step - window (a
+    checkpoint saved inside the detection window may hold the poisoned
+    state) and charges the budget. Returns a RollbackPlan, or None when
+    the budget is spent or nothing restorable exists — the caller
+    escalates to the abort path. `quarantine(plan)` renames newer
+    (suspect-timeline) checkpoints aside so no later `latest` resolution
+    can pick them up.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike = "checkpoints",
+        max_rollbacks: int = 3,
+        coordinator: RollbackCoordinator | None = None,
+    ):
+        if max_rollbacks < 0:
+            raise ValueError(f"max_rollbacks must be >= 0, got {max_rollbacks}")
+        self.directory = Path(directory)
+        self.max_rollbacks = max_rollbacks
+        self.coordinator = coordinator or RollbackCoordinator(None)
+        self.count = 0  # executed rollbacks
+        self.steps_lost = 0
+        self.exhausted = False
+        self.history: list[RollbackPlan] = []
+
+    def plan(self, reason: str, anomaly_step: int, window: int = 0) -> RollbackPlan | None:
+        """Decide (do not execute) the next rollback. None = escalate."""
+        if self.count >= self.max_rollbacks:
+            self.exhausted = True
+            return None
+        max_step = anomaly_step - window
+        target = ckpt_lib.latest_good(self.directory, max_step=max_step)
+        if target is None:
+            self.exhausted = True  # nothing restorable: same escalation
+            return None
+        step = ckpt_lib._step_of(target)
+        return RollbackPlan(
+            seq=self.count + 1,
+            reason=reason,
+            anomaly_step=anomaly_step,
+            target_step=step,
+            target_path=str(target),
+            steps_lost=anomaly_step - step,
+        )
+
+    def committed(self, plan: RollbackPlan) -> None:
+        """Record an executed rollback (after the restore succeeded)."""
+        self.count = plan.seq
+        self.steps_lost += plan.steps_lost
+        self.history.append(plan)
+
+    def quarantine(self, plan: RollbackPlan, process_zero: bool = True) -> list[str]:
+        """Rename checkpoints NEWER than the rollback target aside
+        (`<name>.quarantined-<seq>`): they belong to the abandoned,
+        possibly-poisoned timeline segment, and the glob patterns behind
+        `latest`/`latest_any` must never resolve to them again. Process-0
+        only on shared filesystems (one rename per file, like the
+        publish). Returns the quarantined names."""
+        if not process_zero:
+            return []
+        out = []
+        for path in ckpt_lib.all_checkpoints(self.directory):
+            step = ckpt_lib._step_of(path)
+            if step <= plan.target_step or str(path) == plan.target_path:
+                continue
+            dest = path.with_name(path.name + f".quarantined-{plan.seq:04d}")
+            try:
+                os.replace(path, dest)
+                side = ckpt_lib.checksum_sidecar(path)
+                if side.exists():
+                    os.replace(
+                        side, side.with_name(side.name + f".quarantined-{plan.seq:04d}")
+                    )
+                meta = ckpt_lib.meta_path(path)
+                if meta.exists():
+                    os.replace(
+                        meta, meta.with_name(meta.name + f".quarantined-{plan.seq:04d}")
+                    )
+            except OSError:
+                continue  # a quarantine miss is a warning-level event, not fatal
+            out.append(dest.name)
+        return out

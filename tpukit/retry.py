@@ -1,0 +1,173 @@
+"""Jittered exponential retry/backoff for transient host I/O.
+
+At pod scale the host side of training talks to shared filesystems (NFS,
+GCS-fuse) whose failure mode is the TRANSIENT error: a checkpoint write or
+a dataset fetch that raises once and succeeds on the next attempt. The
+reference (and tpukit before round 9) treated every such error as fatal —
+one flaky `np.savez` killed a fleet-wide run that a 50 ms retry would have
+saved. This module is the one retry policy every host I/O site shares:
+
+  - `retry_io(fn, *args, label=...)` wraps one I/O operation: on a
+    retryable exception it sleeps a jittered exponential backoff and tries
+    again, up to the policy's budget, then FAILS LOUD by re-raising the
+    last error (a retry wrapper that degrades into an infinite loop or a
+    silent swallow is worse than no wrapper).
+  - Retryable means host-I/O-shaped: `OSError` (IOError is its alias) and
+    `TimeoutError`. Programming errors (TypeError, ValueError, KeyError)
+    are never retried — retrying a bug just repeats it slower.
+  - Every retry is OBSERVED: a module-level observer (installed by
+    `fit()`) receives one event per failed attempt, which the trainer
+    logs as a `kind="retry"` JSONL record and a flight-recorder entry, so
+    "the run survived 14 transient NFS errors" is visible in the run
+    summary instead of silently absorbed.
+
+Wired sites (round 9): checkpoint blob/shard/manifest writes and reads —
+sync writers AND the `AsyncCheckpointer` background half — and the
+`DataLoader` batch fetch. The chaos harness (`tpukit/chaos.py`) injects
+deterministic IOErrors inside these exact sites, so the retry path is
+testable end to end without a flaky filesystem.
+
+Thread-safety: `retry_io` runs on the training thread, the async
+checkpoint writer thread, and the prefetch worker; the observer hook and
+the event counter are lock-protected.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+import time
+from typing import Any, Callable
+
+# Exceptions worth a second attempt: transient host-I/O failures. OSError
+# covers IOError (alias), filesystem errno failures, and socket errors.
+RETRYABLE = (OSError, TimeoutError)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded jittered-exponential backoff.
+
+    `retries` is the number of RE-tries after the first attempt (so
+    retries=3 means up to 4 attempts); 0 disables retrying (one attempt,
+    fail loud). Delay before retry k (1-based) is
+    `min(base_delay * 2**(k-1), max_delay)` scaled by a uniform jitter in
+    `[1 - jitter, 1 + jitter]` — the decorrelation that keeps a pod's
+    worth of processes from hammering a recovering filesystem in
+    lockstep.
+    """
+
+    retries: int = 3
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    jitter: float = 0.5
+
+    def __post_init__(self):
+        if self.retries < 0:
+            raise ValueError(f"retries must be >= 0, got {self.retries}")
+        if self.base_delay < 0 or self.max_delay < 0:
+            raise ValueError("delays must be >= 0")
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry `attempt` (1-based)."""
+        base = min(self.base_delay * (2.0 ** (attempt - 1)), self.max_delay)
+        if self.jitter:
+            base *= rng.uniform(1.0 - self.jitter, 1.0 + self.jitter)
+        return base
+
+
+_lock = threading.Lock()
+_default_policy = RetryPolicy()
+_observer: Callable[[dict], None] | None = None
+# Per-PROCESS jitter stream: seeding with the pid is what decorrelates a
+# pod's worth of ranks — a shared constant would have every rank draw the
+# identical delay sequence and retry in lockstep, the thundering herd the
+# jitter exists to prevent. (Replayability lives in the chaos harness, not
+# in retry delays.)
+_rng = random.Random(0x7E72 ^ os.getpid())
+
+
+def set_default_policy(policy: RetryPolicy | None) -> RetryPolicy:
+    """Install the process-wide default policy (fit() sets it from
+    `--io_retries`); returns the previous one so callers can restore it."""
+    global _default_policy
+    with _lock:
+        prev = _default_policy
+        _default_policy = policy if policy is not None else RetryPolicy()
+    return prev
+
+
+def set_observer(fn: Callable[[dict], None] | None) -> None:
+    """Install (or clear) the retry-event observer. Called with one dict
+    per FAILED attempt: {label, attempt, retries, delay_s, error}. The
+    observer must be thread-safe and must never raise (it is wrapped)."""
+    global _observer
+    with _lock:
+        _observer = fn
+
+
+def retry_io(
+    fn: Callable[..., Any],
+    *args,
+    label: str = "io",
+    policy: RetryPolicy | None = None,
+    retryable: tuple = RETRYABLE,
+    sleep: Callable[[float], None] = time.sleep,
+    **kwargs,
+):
+    """Run `fn(*args, **kwargs)`, retrying transient failures per `policy`
+    (default: the process-wide policy). Re-raises the final error once the
+    budget is spent — never returns a sentinel, never loops forever."""
+    with _lock:
+        pol = policy if policy is not None else _default_policy
+        obs = _observer
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retryable as exc:
+            attempt += 1
+            if attempt > pol.retries:
+                raise  # budget spent: fail loud with the real error
+            with _lock:
+                delay = pol.delay(attempt, _rng)
+            if obs is not None:
+                try:
+                    obs(
+                        {
+                            "label": label,
+                            "attempt": attempt,
+                            "retries": pol.retries,
+                            "delay_s": round(delay, 4),
+                            "error": f"{type(exc).__name__}: {exc}",
+                        }
+                    )
+                except Exception:
+                    pass  # observability must never break the I/O path
+            if delay > 0:
+                sleep(delay)
+
+
+class RetryLog:
+    """Thread-safe collector of retry events — the observer `fit()`
+    installs. The training thread drains it at window boundaries into the
+    JSONL/flight-recorder; `total` survives draining for the run metrics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self.total = 0
+
+    def __call__(self, event: dict) -> None:
+        with self._lock:
+            self._events.append(event)
+            self.total += 1
+
+    def drain(self) -> list[dict]:
+        with self._lock:
+            out, self._events = self._events, []
+        return out

@@ -47,7 +47,9 @@ import optax
 from flax import struct
 from tqdm import tqdm
 
+from tpukit import chaos as chaos_lib
 from tpukit import checkpoint as ckpt_lib
+from tpukit import retry as retry_lib
 from tpukit.batching import IGNORE_INDEX, prepare_batch
 from tpukit.cache import enable_compilation_cache
 from tpukit.data import get_dataset, get_tokenizer, transform_dataset
@@ -55,6 +57,15 @@ from tpukit.flags import TrainFlags
 from tpukit.loader import DataLoader
 from tpukit.prefetch import HostPrefetcher
 from tpukit.mesh import initialize_runtime, is_process_zero
+from tpukit.recovery import (
+    AnomalyAbort,
+    Preempted,
+    PreemptCoordinator,
+    PreemptionGuard,
+    RecoveryEngine,
+    RollbackBudgetExhausted,
+    RollbackCoordinator,
+)
 from tpukit.model import gpt
 from tpukit.obs import (
     AnomalyTracer,
@@ -328,9 +339,17 @@ def fit(
     num_epochs: int | None = None,
     make_loaders: Callable | None = None,
 ) -> FitResult:
-    """The shared training entry point every recipe calls."""
+    """The shared training entry point every recipe calls.
+
+    Round 9: `fit` validates the recovery flags, installs the run-scoped
+    environment — SIGTERM/SIGINT preemption handlers, the chaos
+    fault-injection engine (`--chaos_spec`), the transient-I/O retry
+    policy + observer (`--io_retries`) — and guarantees their teardown on
+    EVERY exit path (clean, abort, preemption, crash), so none of it
+    leaks across fits in one process. The training loop itself lives in
+    `_fit_body`.
+    """
     initialize_runtime()
-    p0 = is_process_zero()
     if flags.prefetch < 0:
         raise ValueError(f"--prefetch must be >= 0, got {flags.prefetch}")
     if flags.hang_timeout < 0:
@@ -340,6 +359,67 @@ def fit(
             f"--divergence_check_freq must be >= 0, got "
             f"{flags.divergence_check_freq}"
         )
+    if flags.on_anomaly not in ("none", "rollback"):
+        raise ValueError(
+            f"--on_anomaly must be none|rollback, got {flags.on_anomaly!r}"
+        )
+    if flags.max_rollbacks < 0:
+        raise ValueError(f"--max_rollbacks must be >= 0, got {flags.max_rollbacks}")
+    if flags.io_retries < 0:
+        raise ValueError(f"--io_retries must be >= 0, got {flags.io_retries}")
+    if flags.on_anomaly == "rollback" and jax.process_count() > 1 and not flags.heartbeat_dir:
+        # the rollback decision is made collective through the heartbeat
+        # directory; without it a multi-process world could roll back to
+        # two different steps and deadlock in mismatched collectives
+        raise ValueError(
+            "--on_anomaly rollback needs --heartbeat_dir on multi-process "
+            "runs: the rollback decision is published through the shared "
+            "heartbeat directory"
+        )
+    # Chaos harness (round 9): parse NOW so a typo'd fault plan fails at
+    # startup, not silently never fires. Installed module-wide for the
+    # run's duration (checkpoint/loader I/O sites reach it through
+    # tpukit.chaos.maybe_io_fault); uninstalled on any exit.
+    chaos_engine = (
+        chaos_lib.ChaosEngine(
+            flags.chaos_spec, seed=flags.seed,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
+        if flags.chaos_spec
+        else None
+    )
+    # Transient host-I/O retry policy + observer: every retried attempt
+    # lands in the JSONL (kind="retry") and the flight-recorder ring.
+    retry_log = retry_lib.RetryLog()
+    prev_policy = retry_lib.set_default_policy(
+        retry_lib.RetryPolicy(retries=flags.io_retries)
+    )
+    retry_lib.set_observer(retry_log)
+    prev_chaos = chaos_lib.install(chaos_engine)
+    guard = PreemptionGuard()
+    try:
+        with guard:
+            return _fit_body(
+                flags, strategy, num_epochs, make_loaders,
+                chaos_engine, retry_log, guard,
+            )
+    finally:
+        chaos_lib.install(prev_chaos)
+        retry_lib.set_observer(None)
+        retry_lib.set_default_policy(prev_policy)
+
+
+def _fit_body(
+    flags: TrainFlags,
+    strategy: Strategy,
+    num_epochs: int | None,
+    make_loaders: Callable | None,
+    chaos_engine,
+    retry_log,
+    preempt_guard: PreemptionGuard,
+) -> FitResult:
+    p0 = is_process_zero()
     # Persistent XLA compilation cache (round 7): repeat runs of the same
     # program skip recompiles; hits/misses are logged at the end of the run.
     cache_stats = (
@@ -436,6 +516,12 @@ def fit(
     # Initialize directly into the sharded layout (no host-side giant pytree).
     state = jax.jit(init_fn, out_shardings=state_sharding)(jax.random.PRNGKey(flags.seed))
 
+    # Mid-epoch continuation (round 9): a PREEMPTION save carries resume
+    # metadata (epoch + batches consumed); resuming from one continues the
+    # interrupted epoch at the exact batch it stopped at — the uninterrupted
+    # run's state, bit-exact. Other checkpoints (periodic/final) keep the
+    # established semantics: train `--epochs` more epochs from batch 0.
+    start_epoch, start_skip = 0, 0
     if flags.resume:
         from pathlib import Path
 
@@ -446,6 +532,15 @@ def fit(
             raise FileNotFoundError(
                 f"--resume {flags.resume}: no checkpoint found"
             )
+        if flags.resume != "latest":
+            # `latest_any` already verified its pick (hashing the whole
+            # blob / every shard); only an explicit path needs the check.
+            ok, detail = ckpt_lib.verify_checkpoint(resume_path)
+            if not ok:
+                raise ValueError(
+                    f"--resume {flags.resume}: checkpoint {resume_path} "
+                    f"failed integrity verification ({detail})"
+                )
         # Both formats restore against the abstract state_shapes (never a
         # device_get of the live state — that is exactly the gather that
         # fails for cross-host-sharded state). Sharded checkpoints place
@@ -455,10 +550,26 @@ def fit(
             resume_path, state_shapes, state_sharding
         )
         state = restored if was_sharded else _place_like(restored, state_sharding)
+        meta = ckpt_lib.read_meta(resume_path)
+        if meta and meta.get("preempted"):
+            start_epoch = int(meta.get("epoch", 0))
+            start_skip = int(meta.get("batch_in_epoch", 0))
         if p0:
             print(
                 f"resumed from {resume_path} at step {int(jax.device_get(state.step))}"
+                + (
+                    f" (preempted mid-epoch: continuing epoch {start_epoch} "
+                    f"at batch {start_skip})"
+                    if start_skip or meta and meta.get("preempted")
+                    else ""
+                )
             )
+    if chaos_engine is not None and chaos_engine.skip_batches:
+        # chaos `skip@N`: fast-forward the first trained epoch's stream by
+        # N batches WITHOUT moving the step counter — exactly the stream
+        # position a post-rollback run sits at, which is what lets a
+        # control run reproduce a recovered run's trajectory bit-exactly.
+        start_skip += chaos_engine.skip_batches
 
     batch_sh = strategy.batch_sharding()
     # Host-side batch transform (ContextParallel's zigzag permute — ADVICE
@@ -481,10 +592,12 @@ def fit(
     # periodic saves stop stalling the step loop on encode+disk I/O.
     async_saver = ckpt_lib.AsyncCheckpointer() if flags.async_checkpoint else None
 
-    def save_checkpoint(st):
+    def save_checkpoint(st, meta=None):
         if async_saver is not None:
-            return async_saver.save_auto(st, format=flags.checkpoint_format)
-        return ckpt_lib.save_auto(st, format=flags.checkpoint_format)
+            return async_saver.save_auto(
+                st, format=flags.checkpoint_format, meta=meta
+            )
+        return ckpt_lib.save_auto(st, format=flags.checkpoint_format, meta=meta)
 
     seq = flags.sequence_length - 1  # model sees S-1 after the shift
     meter = MFUMeter(cfg, seq)
@@ -540,10 +653,63 @@ def fit(
     epochs = num_epochs if num_epochs is not None else flags.epochs
     checkpoint_path = None
 
+    # ---- recovery engine (round 9, docs/DESIGN.md "recovery") -----------
+    # --on_anomaly rollback: a sentinel/divergence firing restores the
+    # last integrity-verified checkpoint older than the detection window,
+    # in process, and training continues with the input stream still
+    # moving FORWARD (the offending batch window is never replayed).
+    recovery = (
+        RecoveryEngine(
+            "checkpoints",
+            max_rollbacks=flags.max_rollbacks,
+            coordinator=RollbackCoordinator(
+                flags.heartbeat_dir or None,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+                timeout_s=flags.heartbeat_timeout,
+            ),
+        )
+        if flags.on_anomaly == "rollback"
+        else None
+    )
+    timeline = 0  # collective rollbacks executed (tags heartbeat checksums)
+    skip_save_step = -1  # suppress the periodic re-save right after a restore
+    # Multi-process preemption is collectivized the same way (see
+    # recovery.PreemptCoordinator): the graceful checkpoint is a
+    # step-keyed collective write, so every rank must save at the same
+    # step even though their host loops observe the signal at different
+    # wall-clocks. Without a shared heartbeat directory we fall back to
+    # the uncoordinated poll and say so once.
+    preempt_coord = None
+    if jax.process_count() > 1:
+        if flags.heartbeat_dir:
+            preempt_coord = PreemptCoordinator(
+                flags.heartbeat_dir,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+            )
+        elif jax.process_index() == 0:
+            import warnings
+
+            warnings.warn(
+                "multi-process run without --heartbeat_dir: a SIGTERM/"
+                "SIGINT preemption checkpoint cannot be coordinated across "
+                "processes and may deadlock the step-keyed collective save "
+                "if ranks observe the signal at different steps"
+            )
+
     # The step counter is tracked on host (one D2H sync here, after a
     # possible resume, then pure host arithmetic) so periodic checkpointing
     # never forces a per-step `int(state.step)` sync inside the hot loop.
     host_step = int(state.step)
+    if preempt_coord is not None:
+        # Tag this incarnation's coordination records with its starting
+        # step: every rank restores the same checkpoint, so the tag is
+        # collective for free, and a stale decision/request that survives
+        # the init cleanup (relaunch race — a fast rank can poll before a
+        # slow p0's sweep) can never match a resumed run, whose start step
+        # sits exactly ON the stale decision's execute_after boundary.
+        preempt_coord.run_start = host_step
 
     # ---- failure observability (round 8): watchdog + bundles + trace-on-
     # anomaly + divergence checksums (docs/DESIGN.md "failure
@@ -656,6 +822,314 @@ def fit(
             )
         return path
 
+    # ---- round-9 helpers: side-event drain, preemption, rollback --------
+
+    def drain_side_events() -> None:
+        """Surface retry/chaos events collected since the last drain (they
+        fire on any thread: training, async-checkpoint writer, prefetch
+        worker) into the JSONL + flight recorder, on the training thread."""
+        for ev in retry_log.drain():
+            logger.log(kind="retry", step=host_step, **ev)
+            recorder.record("retry", step=host_step, **ev)
+        if chaos_engine is not None:
+            for ev in chaos_engine.drain_fired():
+                rec = dict(ev)
+                rec.setdefault("step", host_step)
+                logger.log(kind="chaos", **rec)
+                recorder.record("chaos", **rec)
+
+    null_polls = [0]  # eval/generate-phase poll throttle (see below)
+
+    def check_preempt(consumed: int | None, epoch_idx: int) -> None:
+        """Graceful preemption (SIGTERM/SIGINT → exit code 75): polled at
+        iteration boundaries, where device state is coherent. Writes a
+        DURABLE checkpoint carrying resume metadata — the epoch and the
+        number of batches consumed (`consumed=None` means the epoch's
+        training phase is complete) — so `--resume latest` continues the
+        interrupted epoch at the exact batch it stopped at, bit-exact."""
+        sig = preempt_guard.pending
+        if preempt_coord is not None:
+            # Multi-process: collectivize through the heartbeat directory.
+            # Ranks publish their pending signal as a request; process 0
+            # turns the first request into a decision naming a window
+            # boundary ≥ one full window ahead (host loops can run up to a
+            # window past the collective frontier, so anything closer could
+            # already be behind a rank); every rank's deterministic
+            # host-step counter passes through that boundary's poll exactly
+            # once, so the step-keyed collective save matches. A decision
+            # whose boundary falls past the end of training is never
+            # executed — all ranks uniformly finish clean (exit 0), which
+            # is strictly better than a preempt exit anyway.
+            boundary = consumed is None or host_step % PRINT_FREQ == 0
+            if sig is not None:
+                preempt_coord.request(sig)
+            elif not boundary:
+                return  # cheap poll: no signal here, not a boundary step
+            elif consumed is None:
+                # eval/generate call this per batch with host_step frozen:
+                # a per-batch decision-file read (plus p0's request glob)
+                # hammers a shared filesystem for nothing. Poll at the
+                # window cadence instead — the counter advances identically
+                # on every rank (same batch sequence), so a matching
+                # decision is still executed by all ranks at the same poll.
+                # An actual local signal (sig above) is never throttled.
+                null_polls[0] += 1
+                if null_polls[0] % PRINT_FREQ:
+                    return
+            dec = preempt_coord.read()
+            if dec is None and p0 and boundary:
+                req = sig or preempt_coord.any_request()
+                if req is not None:
+                    dec = preempt_coord.publish(
+                        req,
+                        execute_after=(
+                            (host_step // PRINT_FREQ + 2) * PRINT_FREQ
+                        ),
+                    )
+            if dec is None:
+                return
+            if host_step != int(dec["execute_after"]):
+                # not the decision's boundary: keep training (epoch-end
+                # polls included — the in-loop poll at execute_after is hit
+                # by every rank, possibly in the next epoch)
+                return
+            sig = dec["signal"]
+        elif sig is None:
+            return
+        if watchdog is not None:
+            watchdog.disarm()
+        if consumed is None:
+            ep, nb = epoch_idx + 1, 0
+        else:
+            ep, nb = epoch_idx, consumed
+            spe = (
+                len(train_loader)
+                if hasattr(train_loader, "__len__")
+                else None
+            )
+            if spe is not None and nb >= spe:
+                ep, nb = ep + 1, 0  # epoch boundary: resume starts the next
+        meta = {
+            "step": host_step, "epoch": ep, "batch_in_epoch": nb,
+            "preempted": True, "signal": sig,
+        }
+        with spans.span("checkpoint"):
+            path = save_checkpoint(state, meta=meta)
+            if async_saver is not None:
+                # the exit is imminent: the checkpoint must be durable NOW
+                async_saver.wait()
+        recorder.record("preempt", step=host_step, signal=sig)
+        logger.log(
+            kind="preempt", step=host_step, signal=sig, epoch=ep,
+            batch_in_epoch=nb, checkpoint=str(path),
+        )
+        if heart is not None:
+            heart.beat(host_step, timeline=timeline)
+        drain_side_events()
+        if p0:
+            print(f"preempted by {sig} at step {host_step}; checkpoint {path}")
+        logger.close()
+        raise Preempted(
+            f"{sig} at step {host_step}; checkpoint {path}; relaunch with "
+            f"--resume latest to continue",
+            checkpoint=path, step=host_step,
+        )
+
+    def abort_with(exc_cls, message: str):
+        """The round-8 bundle-dump-and-abort tail shared by --spike_action
+        abort and rollback-budget exhaustion: preserve the blown-up state
+        for autopsy, then fail loudly with the documented exit code."""
+        nonlocal checkpoint_path
+        with spans.span("checkpoint"):
+            checkpoint_path = save_checkpoint(state) or checkpoint_path
+            if async_saver is not None:
+                # abort must leave a DURABLE autopsy
+                async_saver.wait()
+        drain_side_events()
+        # (the raise unwinds through _cleanup, which closes this epoch's
+        # prefetcher and bar)
+        logger.close()
+        raise exc_cls(f"{message}; state checkpointed at {checkpoint_path}")
+
+    # Jitted identity at the strategy's shardings, used by execute_rollback
+    # below. Hoisted so every rollback reuses one traced/compiled program
+    # (jit's cache is keyed on function identity — a fresh lambda per call
+    # would re-trace inside the quiesce window).
+    _relaunder = jax.jit(lambda s: s, out_shardings=state_sharding)
+
+    def execute_rollback(plan) -> None:
+        """Restore the plan's checkpoint in process and reset every piece
+        of host state that belongs to the abandoned timeline segment. The
+        input stream is NOT rewound: the loader/prefetcher keeps streaming
+        forward, so the batch window that fired the anomaly is never
+        replayed."""
+        nonlocal state, host_step, running, win_n, norms, last_checksum
+        nonlocal timeline, skip_save_step
+        if watchdog is not None:
+            watchdog.disarm()  # restore I/O may exceed the step deadline
+        if async_saver is not None:
+            # An in-flight async save of an abandoned-timeline step must
+            # publish BEFORE the quarantine sweep, or it would land after
+            # it — resurrecting a possibly-poisoned checkpoint that a later
+            # rollback/resume could restore.
+            async_saver.wait()
+        quarantined = recovery.quarantine(plan, process_zero=p0)
+        # Quiesce the prefetch worker across the restore: its batch
+        # device_puts racing the restore's state placement corrupts the
+        # CPU runtime (prefetch.HostPrefetcher.quiesce). Buffered batches
+        # keep serving — production pauses, the stream position holds.
+        pf = pf_live["pf"]
+        pf_quiet = pf.quiesce() if pf is not None else contextlib.nullcontext()
+        with pf_quiet, spans.span("checkpoint"):
+            restored, was_sharded = ckpt_lib.restore_any(
+                plan.target_path, state_shapes, state_sharding
+            )
+            state = (
+                restored if was_sharded else _place_like(restored, state_sharding)
+            )
+            # Launder the restored pytree through a jitted identity: the
+            # next train_step dispatch then sees ordinary jit-output
+            # arrays and takes the fast path, instead of re-placing
+            # host-restored arrays inside the dispatch — host-side work
+            # that would land OUTSIDE this quiesce and race the prefetch
+            # worker's device_put (same corruption the quiesce exists
+            # for). Compiled once, cached across rollbacks.
+            state = _relaunder(state)
+            jax.block_until_ready(jax.tree_util.tree_leaves(state))
+        host_step = plan.target_step
+        skip_save_step = host_step  # the target step's checkpoint exists
+        running, win_n, norms = None, 0, None
+        if sentinel is not None:
+            # post-restore losses revisit an OLDER point of the curve; the
+            # pre-anomaly baseline would re-fire on a healthy recovery
+            sentinel.reset()
+        pending_checks.clear()
+        last_checksum = None
+        timeline += 1  # heartbeat checksums from before the rollback are
+        # now a different timeline: equal step numbers, different data
+        recovery.committed(plan)
+        recovery.coordinator.ack(plan.seq, plan.target_step)
+        rec = plan.record()
+        logger.log(kind="rollback", timeline=timeline, quarantined=quarantined, **rec)
+        recorder.record("rollback", **rec)
+        if heart is not None:
+            heart.beat(host_step, timeline=timeline)
+        if p0:
+            print(
+                f"rollback {plan.seq}/{recovery.max_rollbacks} "
+                f"({plan.reason} at step {plan.anomaly_step}): restored "
+                f"{plan.target_path} at step {plan.target_step}, "
+                f"{plan.steps_lost} steps lost; input stream continues "
+                f"forward"
+            )
+
+    def try_rollback(reason: str, anomaly_step: int) -> bool:
+        """Immediate collective rollback — for anomalies EVERY process
+        observes in lockstep (the sentinel's window loss is replicated).
+        Each process computes the same plan from the shared checkpoint
+        directory; process 0 publishes the decision record and the others
+        confirm theirs against it before restoring. False = escalate."""
+        if recovery is None:
+            return False
+        plan = recovery.plan(reason, anomaly_step, window=PRINT_FREQ)
+        if plan is None:
+            return False
+        if jax.process_index() == 0:
+            recovery.coordinator.publish(plan)
+        else:
+            recovery.coordinator.confirm(plan)
+        execute_rollback(plan)
+        return True
+
+    pending_deferred: dict[int, Any] = {}  # p0's not-yet-executed decisions
+
+    def defer_rollback(reason: str, anomaly_step: int) -> bool:
+        """Deferred collective rollback — for anomalies only process 0
+        observes (divergence). The decision file is published one window
+        AHEAD of execution so every process discovers it on the shared
+        heartbeat directory and executes at the same boundary."""
+        seq = recovery.count + 1
+        if seq in pending_deferred or recovery.coordinator.read(seq) is not None:
+            # A decision for this anomaly is already in flight (a persistent
+            # divergence re-fires at every boundary until the rollback
+            # executes). Re-publishing would push execute_after back each
+            # window — postponing the rollback forever — and a rank that
+            # already read the old record would execute at the old boundary
+            # while p0 waits for the new one: split-brain.
+            return True
+        plan = recovery.plan(reason, anomaly_step, window=PRINT_FREQ)
+        if plan is None:
+            return False
+        recovery.coordinator.publish(
+            plan, execute_after=anomaly_step + PRINT_FREQ
+        )
+        pending_deferred[plan.seq] = plan
+        return True
+
+    def poll_rollback(final: bool = False) -> None:
+        """Window-boundary poll (every process, multi-process worlds):
+        execute a published deferred decision once its execute_after step
+        is reached. `final=True` (end of the last epoch's training phase)
+        executes a still-pending decision regardless of its boundary — a
+        decision published during the LAST window has no later boundary,
+        and dropping it would eval, save, and exit 0 on the diverged
+        state. Every rank reaches the final drain at the same host_step,
+        so the restore's (or abort's) collectives still match. The drain
+        itself is a rendezvous: process 0 publishes a final-drain marker
+        AFTER anything it will ever publish is on disk, and other ranks
+        wait (bounded) for it before trusting a None read — p0 detects
+        divergence inside its last boundary block (heartbeat reads +
+        hashing, slow), so a faster rank's lone read could land before
+        the publish and sail into eval on the diverged state."""
+        if recovery is None or jax.process_count() == 1:
+            return
+        seq = recovery.count + 1
+        plan = pending_deferred.pop(seq, None)
+        if plan is not None:  # process 0's own deferred decision
+            if final or host_step >= plan.anomaly_step + PRINT_FREQ:
+                if final:
+                    # marker before the (long) restore: other ranks can
+                    # read the already-published decision and restore
+                    # concurrently instead of waiting out p0's I/O
+                    recovery.coordinator.publish_final_drain(host_step)
+                execute_rollback(plan)
+            else:
+                pending_deferred[seq] = plan
+            return
+        if final:
+            if p0:
+                recovery.coordinator.publish_final_drain(host_step)
+            else:
+                recovery.coordinator.wait_final_drain()
+        rec = recovery.coordinator.read(seq)
+        if rec is None or "execute_after" not in rec:
+            return  # nothing pending (immediate decisions ran via confirm)
+        if not final and host_step < int(rec["execute_after"]):
+            return
+        if rec.get("action") == "abort":
+            # collective-abort decision (publish_abort): every process —
+            # including the p0 that published it — reaches abort_with here
+            # at the same boundary, so the autopsy checkpoint's collective
+            # completes before the run exits 77
+            abort_with(
+                RollbackBudgetExhausted,
+                f"{rec['reason']} at step {rec['anomaly_step']}: rollback "
+                f"budget exhausted ({recovery.count}/"
+                f"{recovery.max_rollbacks} used) or no integrity-verified "
+                f"checkpoint to restore",
+            )
+        from tpukit.recovery import RollbackPlan
+
+        execute_rollback(
+            RollbackPlan(
+                seq=int(rec["seq"]), reason=rec["reason"],
+                anomaly_step=int(rec["anomaly_step"]),
+                target_step=int(rec["target_step"]),
+                target_path=rec["target_path"],
+                steps_lost=int(rec["steps_lost"]),
+            )
+        )
+
     if heart is not None:
         heart.beat(host_step)  # liveness file exists before the first compile
 
@@ -684,12 +1158,22 @@ def fit(
     # _cleanup: any exception unwinding the loop (debug_nans aborts, device
     # OOM, KeyboardInterrupt) must release the epoch's prefetch worker —
     # close() is idempotent, so registering each epoch's prefetcher is safe.
+    # A run resumed AT the end of training (preempted during the final
+    # epoch's eval phase → meta epoch == epochs) never enters the epoch
+    # loop, so eval_metrics must exist before it.
+    eval_metrics = {}
     with contextlib.ExitStack() as _obs_guard, maybe_nojit, maybe_nans, \
             trace(flags.profile_dir), contextlib.ExitStack() as _cleanup:
         _obs_guard.callback(_close_obs)
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             # ---- train ---------------------------------------------------
             train_loader.set_epoch(epoch)
+            # Mid-epoch continuation of a preempted run: drop the batches
+            # the interrupted run already trained on, so the resumed epoch
+            # consumes exactly the remainder (bit-exact with the
+            # uninterrupted run; the per-epoch shuffle is seeded, so the
+            # stream is reproducible).
+            skip = start_skip if epoch == start_epoch else 0
             # Exact global real-row schedule (VERDICT r4 #6): pure host math
             # (wrap-pad positions don't depend on the shuffle), so the meter
             # is exact on ragged final batches without a per-step cross-host
@@ -705,10 +1189,16 @@ def fit(
             # contract: iterable + set_epoch; __len__ optional)
             bar = tqdm(
                 total=len(train_loader) if hasattr(train_loader, "__len__") else None,
+                initial=skip,
                 disable=not p0,
             )
             bar.set_description(f"[training] Epoch {epoch+1}/{epochs} | loss: ?????")
-            running = None
+            # win_n counts the losses actually accumulated this window: a
+            # mid-epoch resume (or chaos skip@N) starts i mid-window, so
+            # the first boundary may close over fewer than PRINT_FREQ
+            # steps — dividing by the nominal width would understate the
+            # logged loss and seed the spike sentinel's baseline with it.
+            running, win_n = None, 0
             norms = None  # on-device window norms when --log_grad_norms
             # Input source (round 7): with --prefetch N (default 2) a
             # background thread runs the whole host pipeline N batches
@@ -719,7 +1209,10 @@ def fit(
             # One prefetcher per epoch: set_epoch has already run, and the
             # epoch boundary flushes instead of buffering across epochs.
             pf = (
-                HostPrefetcher(train_loader, host_pipeline, depth=flags.prefetch)
+                HostPrefetcher(
+                    train_loader, host_pipeline, depth=flags.prefetch,
+                    skip=skip,
+                )
                 if flags.prefetch > 0
                 else None
             )
@@ -727,9 +1220,18 @@ def fit(
             if pf is not None:
                 _cleanup.callback(pf.close)
             _cleanup.callback(bar.close)
-            it = iter(train_loader) if pf is None else None
-            i = -1
+            if pf is None:
+                it = iter(train_loader)
+                for _ in range(skip):  # sync path's resume fast-forward
+                    next(it, None)
+            else:
+                it = None
+            i = skip - 1
             while True:
+                # Preemption poll: SIGTERM/SIGINT landed since the last
+                # iteration → graceful checkpoint-and-exit (code 75) at a
+                # boundary where device state is coherent.
+                check_preempt(i + 1, epoch)
                 # The watchdog deadline covers the WHOLE iteration — input
                 # wait, dispatch, window sync, periodic checkpoint — so a
                 # hang in any of them trips it; re-arming each iteration
@@ -774,6 +1276,27 @@ def fit(
                 warm["train"] = True
                 host_step += 1
                 recorder.record("step", step=host_step, epoch=epoch)
+                if chaos_engine is not None:
+                    # deterministic fault injection at exactly this step:
+                    # poisoned losses enter the window average below, a
+                    # flipped bit enters the next divergence checksum, an
+                    # injected signal is polled right here. A bitflip
+                    # device_puts into the state on THIS thread, so it
+                    # takes the same prefetcher quiesce the rollback
+                    # restore does (two threads must never place at once).
+                    _pf = pf_live["pf"]
+                    _quiet = (
+                        _pf.quiesce()
+                        if _pf is not None
+                        and chaos_engine.mutates_state_at(host_step)
+                        else contextlib.nullcontext()
+                    )
+                    with _quiet:
+                        state, loss, _fired = chaos_engine.on_step(
+                            host_step, state, loss
+                        )
+                    if _fired:
+                        check_preempt(i + 1, epoch)
                 if tracer is not None and tracer.tracing and tracer.step():
                     logger.log(
                         kind="anomaly_trace", event="stopped", step=host_step
@@ -785,6 +1308,7 @@ def fit(
                     with spans.span("telemetry"):
                         pending_checks.append((host_step, checksum_fn(state)))
                 running = loss if running is None else running + loss
+                win_n += 1
                 # Honest throughput (VERDICT r2 #8): count only original
                 # dataset rows — wrap-padding duplicates train but are not
                 # new tokens; the precomputed global schedule makes the
@@ -797,8 +1321,16 @@ def fit(
                 else:
                     meter.update(real_rows * loader_procs * targets.shape[1])
                 if i > 0 and not i % PRINT_FREQ:
+                    # Rollbacks executed inside this boundary block (an
+                    # immediate divergence rollback above, or a deferred
+                    # decision in poll_rollback) reset the sentinel and
+                    # rewind host_step — `avg` then belongs to the
+                    # abandoned timeline and must not seed the cleared
+                    # history (a poisoned avg would even re-fire the NaN
+                    # sentinel and burn a second budget slot).
+                    pre_rollbacks = recovery.count if recovery is not None else 0
                     with spans.span("sync"):
-                        avg = float(running) / PRINT_FREQ  # one D2H sync per window
+                        avg = float(running) / win_n  # one D2H sync per window
                         norm_vals = (
                             {k: float(v) for k, v in norms.items()}
                             if norms is not None
@@ -855,7 +1387,8 @@ def fit(
                             ],
                         )
                         note_anomaly("hang", host_step)
-                    running = None
+                    running, win_n = None, 0
+                    drain_side_events()
                     if pending_checks:
                         with spans.span("telemetry"):
                             flush_checks()
@@ -866,6 +1399,7 @@ def fit(
                             checksum_step=(
                                 last_checksum[0] if last_checksum else None
                             ),
+                            timeline=timeline,
                         )
                         if p0:
                             # step_lag = one window: SPMD lockstep keeps
@@ -924,7 +1458,52 @@ def fit(
                                         "divergence", host_step,
                                         mismatches=diverged,
                                     )
-                    if sentinel is not None:
+                                    if recovery is not None:
+                                        # divergence is a p0-only
+                                        # observation: single-process
+                                        # rolls back right here;
+                                        # multi-process publishes the
+                                        # decision one window ahead and
+                                        # poll_rollback executes it on
+                                        # every process
+                                        did = (
+                                            try_rollback
+                                            if jax.process_count() == 1
+                                            else defer_rollback
+                                        )("divergence", host_step)
+                                        if not did:
+                                            if jax.process_count() == 1:
+                                                abort_with(
+                                                    RollbackBudgetExhausted,
+                                                    f"divergence at step "
+                                                    f"{host_step}: rollback "
+                                                    f"budget exhausted "
+                                                    f"({recovery.count}/"
+                                                    f"{recovery.max_rollbacks} "
+                                                    f"used) or no integrity-"
+                                                    f"verified checkpoint to "
+                                                    f"restore",
+                                                )
+                                            else:
+                                                # A lone-p0 abort_with would
+                                                # strand the other ranks in
+                                                # the autopsy checkpoint's
+                                                # collective: publish the
+                                                # abort one window ahead and
+                                                # every process (p0 too)
+                                                # executes it in
+                                                # poll_rollback.
+                                                recovery.coordinator.publish_abort(
+                                                    recovery.count + 1,
+                                                    "divergence", host_step,
+                                                    execute_after=(
+                                                        host_step + PRINT_FREQ
+                                                    ),
+                                                )
+                    poll_rollback()
+                    if sentinel is not None and (
+                        recovery is None or recovery.count == pre_rollbacks
+                    ):
                         event = sentinel.observe(avg, host_step)
                         if event is not None:
                             spike_events += 1
@@ -943,29 +1522,47 @@ def fit(
                                     f"loss sentinel: {event.kind} at step "
                                     f"{event.step} (loss {event.loss:.4g})"
                                 )
-                            if flags.spike_action == "abort":
-                                # Preserve the blown-up state for autopsy,
-                                # then fail loudly. Collective-consistent:
-                                # every process observed the same replicated
-                                # loss and takes this branch together.
-                                with spans.span("checkpoint"):
-                                    checkpoint_path = (
-                                        save_checkpoint(state)
-                                        or checkpoint_path
+                            if recovery is not None:
+                                # Collective-consistent recovery: every
+                                # process observed the same replicated
+                                # window loss, so all reach this rollback
+                                # in lockstep (process 0 publishes the
+                                # decision record, the rest confirm).
+                                # Budget exhausted (or nothing restorable)
+                                # escalates to the round-8 bundle-dump-
+                                # and-abort path with exit code 77.
+                                if not try_rollback(event.kind, host_step):
+                                    abort_with(
+                                        RollbackBudgetExhausted,
+                                        f"loss sentinel {event.kind} at "
+                                        f"step {event.step} (loss "
+                                        f"{event.loss:.6g}): rollback "
+                                        f"budget exhausted "
+                                        f"({recovery.count}/"
+                                        f"{recovery.max_rollbacks} used) "
+                                        f"or no integrity-verified "
+                                        f"checkpoint to restore",
                                     )
-                                    if async_saver is not None:
-                                        # abort must leave a DURABLE autopsy
-                                        async_saver.wait()
-                                # (the raise unwinds through _cleanup, which
-                                # closes this epoch's prefetcher and bar)
-                                logger.close()
-                                raise RuntimeError(
+                            elif flags.spike_action == "abort":
+                                # Preserve the blown-up state for autopsy,
+                                # then fail loudly (exit code 76).
+                                # Collective-consistent: every process
+                                # observed the same replicated loss and
+                                # takes this branch together.
+                                abort_with(
+                                    AnomalyAbort,
                                     f"loss sentinel aborted training: "
                                     f"{event.kind} at step {event.step} "
-                                    f"(loss {event.loss:.6g}); state "
-                                    f"checkpointed at {checkpoint_path}"
+                                    f"(loss {event.loss:.6g})",
                                 )
-                if flags.checkpoint_every and host_step % flags.checkpoint_every == 0:
+                if (
+                    flags.checkpoint_every
+                    and host_step % flags.checkpoint_every == 0
+                    # right after a rollback the restored step's checkpoint
+                    # is exactly what is already on disk — re-saving it
+                    # would only trip the sharded same-step-re-save warning
+                    and host_step != skip_save_step
+                ):
                     if watchdog is not None:
                         # checkpoint I/O (sync writer: encode + disk) may
                         # legitimately exceed the step deadline; the next
@@ -985,6 +1582,15 @@ def fit(
             pf_live["pf"] = None
             if watchdog is not None:
                 watchdog.disarm()
+            if epoch == epochs - 1:
+                # A deferred decision (divergence rollback or collective
+                # abort) published during the run's LAST training window
+                # names a boundary no training poll will ever reach. Drain
+                # it here — before eval and the final save — or the run
+                # would evaluate, checkpoint, and exit 0 on the diverged
+                # state. (Earlier epochs need no drain: host_step keeps
+                # advancing, so the next epoch's boundary polls reach it.)
+                poll_rollback(final=True)
 
             # ---- validation ---------------------------------------------
             bar = tqdm(validation_loader, disable=not p0)
@@ -994,6 +1600,9 @@ def fit(
             total_loss, total_acc, total_weight = 0.0, 0.0, 0.0
             eval_metrics = {"loss": float("nan"), "accuracy": float("nan")}
             for i, raw in enumerate(bar):
+                # the epoch's training phase is complete: a preemption here
+                # checkpoints end-of-epoch state and resumes at epoch+1
+                check_preempt(None, epoch)
                 # eval steps hang in the same collectives train steps do;
                 # same deadline, same first-call compile exemption
                 if watchdog is not None and warm["eval"]:
@@ -1042,6 +1651,7 @@ def fit(
             # clamp the decode budget so tiny --sequence_length debug
             # runs still fit a prompt in the position table
             gen_tokens = min(20, cfg.max_position_embeddings - 2)
+            check_preempt(None, epoch)
             with spans.span("generate"):
                 texts = generate_samples(
                     strategy, state, cfg, tokenizer, max_new_tokens=gen_tokens
@@ -1071,6 +1681,7 @@ def fit(
                     host_step,
                     checksum=last_checksum[1] if last_checksum else None,
                     checksum_step=last_checksum[0] if last_checksum else None,
+                    timeline=timeline,
                 )
             if p0:
                 print(f"epoch {epoch+1} wallclock: {format_breakdown(ep)}")
@@ -1083,6 +1694,10 @@ def fit(
         # exit barrier: fit() must not return before the last write is
         # durable (the caller may read or delete the checkpoint next)
         async_saver.wait()
+    # Retries/chaos firings since the last window boundary — the epoch tail
+    # (validation/generation loader fetches) and the final save above — must
+    # reach the JSONL before the logger closes.
+    drain_side_events()
     if cache_stats is not None and p0:
         cs = cache_stats.stats()
         logger.log(kind="compile_cache", **cs)
@@ -1095,7 +1710,7 @@ def fit(
     logger.close()
 
     metrics = {
-        "eval": eval_metrics if epochs else {},
+        "eval": eval_metrics,
         "tokens_per_sec": meter.tokens_per_sec,
         "tokens_per_sec_per_chip": meter.tokens_per_sec_per_chip,
         "mfu": meter.mfu,

@@ -7,10 +7,14 @@ marks the EP row "not required"); this recipe closes that row anyway, the
 TPU way. `--num_experts N` replaces every layer's FFN with a Switch-style
 top-1 routed expert bank (fixed-capacity dispatch — static shapes — and
 the Switch load-balance aux loss; see tpukit/model/gpt.py _apply_moe_ffn).
-The ExpertParallel strategy shards the expert axis over an `expert` mesh
-axis while batch rows shard over every device: GSPMD turns the
-dispatch/combine einsums into the token all_to_alls GPU MoE frameworks
-hand-write with NCCL (tpukit/shardings.py ExpertParallel).
+The ExpertParallel strategy shards the expert bank over an `expert` mesh
+axis, the dense trunk + its Adam moments FSDP-style over `data`, and —
+with the default `--moe_dispatch a2a` — moves tokens through hand-placed
+`lax.all_to_all` pairs inside shard_map (tpukit/ops/moe_dispatch.py), the
+collectives GPU MoE frameworks hand-write with NCCL, in both the forward
+and the backward. `--moe_dispatch xla` restores the round-5
+einsum-and-GSPMD dispatch for comparison (its backward degrades to a
+replicate-repartition; see tpukit/shardings.py ExpertParallel).
 
 The device grid puts `expert` innermost (its all_to_alls ride the fastest
 ICI links) with remaining devices data-parallel, e.g. 8 devices and 8
@@ -41,7 +45,7 @@ def pick_grid(n_devices: int, num_experts: int) -> dict:
 def main(argv=None):
     flags = parse_flags(argv, num_experts=True)
     grid = pick_grid(len(jax.devices()), flags.num_experts)
-    return fit(flags, ExpertParallel(create_mesh(grid)))
+    return fit(flags, ExpertParallel(create_mesh(grid), dispatch=flags.moe_dispatch))
 
 
 if __name__ == "__main__":

@@ -17,8 +17,12 @@ in the training section. Round-8 failure observability adds "watchdog"
 "rollback" (in-process restores: count, steps lost, quarantined
 checkpoints), "preempt" (graceful SIGTERM/SIGINT checkpoint-and-exit),
 "retry" (transient host-I/O attempts absorbed by backoff), and "chaos"
-(the fault-injection audit trail). This tool needs NOTHING but
-the file — no jax import, so it runs anywhere the log was copied to.
+(the fault-injection audit trail). Round-10 expert parallelism adds an
+all-to-all dispatch audit line to the "xla" section (the strategy's
+closed-form payload vs the compiled HLO's) and renders bench.py's
+`moe_ep_comm` record when pointed at a bench JSON. This tool needs
+NOTHING but the file — no jax import, so it runs anywhere the log was
+copied to.
 
 Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
 """
@@ -176,6 +180,30 @@ def summarize(records: list[dict]) -> str:
                 w(f"    {op:<20} x{rec['count']:<4} {human_bytes(rec['bytes'])}{flag}")
         elif expected:
             w(f"  comm: none found (strategy expected {sorted(expected)})")
+        # round-10 hand-scheduled dispatch audit: the strategy's closed-form
+        # all-to-all payload vs what the compiled HLO actually moves. Eval
+        # steps on CPU backends upcast bf16 to f32 (2x bytes) — counts are
+        # the hard signal there.
+        a2a_exp = r.get("a2a_expected")
+        if a2a_exp is not None:
+            meas = coll.get("all-to-all") or {"count": 0, "bytes": 0}
+            count_ok = meas["count"] == a2a_exp.get("count")
+            bytes_ok = meas["bytes"] == a2a_exp.get("bytes")
+            if count_ok and bytes_ok:
+                verdict = "  OK"
+            elif count_ok and r.get("backend") == "cpu":
+                # XLA:CPU upcasts bf16 compute to f32, doubling a2a bytes
+                # while op counts still match — only the CPU backend gets
+                # this excuse; a byte drift on an accelerator with the
+                # counts intact is exactly the payload-regression class
+                # this audit exists to flag
+                verdict = "  counts OK (bytes differ: CPU bf16-upcast)"
+            else:
+                verdict = "  <- MISMATCH"
+            w(f"  all-to-all dispatch audit: measured x{meas['count']} "
+              f"{human_bytes(meas['bytes'])} vs expected "
+              f"x{a2a_exp.get('count')} {human_bytes(a2a_exp.get('bytes'))}"
+              + verdict)
 
     val = _rows(records, "validation")
     epochs = _rows(records, "epoch")
@@ -291,6 +319,26 @@ def summarize(records: list[dict]) -> str:
           + (f"hits {hits}  misses {misses}  "
              if hits is not None else "")
           + f"entries {r.get('entries', '-')} (+{r.get('new_entries', 0)} this run)")
+    # bench.py output is itself one JSON line, so `python tools/report.py
+    # bench.json` renders it too; the round-10 moe_ep_comm record is the
+    # EP dispatch audit (expected vs measured all-to-all, remat warnings).
+    for r in records:
+        moe = r.get("moe_ep_comm")
+        if not isinstance(moe, dict):
+            continue
+        w("== moe ep comm (bench) ==")
+        mesh = moe.get("mesh") or {}
+        w(f"  mesh {mesh}  dispatch {moe.get('dispatch', '?')}   "
+          f"tokens/sec/chip {human_count(moe.get('tokens_per_sec_per_chip'))}")
+        exp, meas = moe.get("expected_a2a") or {}, moe.get("measured_a2a") or {}
+        w(f"  all-to-all: measured x{meas.get('count', 0)} "
+          f"{human_bytes(meas.get('bytes', 0))} vs expected "
+          f"x{exp.get('count', 0)} {human_bytes(exp.get('bytes', 0))}"
+          + ("  OK" if moe.get("bytes_match") else "  <- MISMATCH"))
+        warns = moe.get("involuntary_remat_warnings")
+        if warns is not None:
+            w(f"  involuntary-remat warnings at compile: {warns}"
+              + ("" if warns == 0 else "  <- GSPMD replicate-repartition!"))
     return "\n".join(out)
 
 

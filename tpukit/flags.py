@@ -158,6 +158,13 @@ class TrainFlags:
     # routes to (1 = Switch, 2 = GShard/Mixtral-style top-2).
     num_experts: int = 0
     moe_top_k: int = 1
+    # main-moe.py only: expert dispatch dataflow (round 10). "a2a" (default)
+    # hand-places the token exchange as a shard_map lax.all_to_all pair over
+    # the `expert` mesh axis — forward AND backward — instead of leaving the
+    # dispatch einsums to GSPMD, whose backward falls into involuntary
+    # replicate-repartition (MULTICHIP_r05.json). "xla" restores the
+    # round-5 einsum-and-GSPMD behavior for comparison.
+    moe_dispatch: str = "a2a"
 
 
 # The canonical 12 flags of every reference recipe (main-single.py:156-167).
@@ -201,6 +208,9 @@ def build_parser(
     if num_experts:
         parser.add_argument("--num_experts", type=int, default=8)
         parser.add_argument("--moe_top_k", type=int, default=1)
+        parser.add_argument(
+            "--moe_dispatch", choices=("a2a", "xla"), default="a2a"
+        )
     parser.add_argument("--seed", type=int, default=defaults.seed)
     parser.add_argument("--dropout", type=float, default=defaults.dropout)
     parser.add_argument("--checkpoint_every", type=int, default=defaults.checkpoint_every)
@@ -272,4 +282,5 @@ def parse_flags(
     kw.setdefault("pipeline_schedule", "gpipe")
     kw.setdefault("num_experts", 0)
     kw.setdefault("moe_top_k", 1)
+    kw.setdefault("moe_dispatch", "a2a")
     return TrainFlags(**kw)

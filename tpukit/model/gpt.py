@@ -49,6 +49,7 @@ import jax.numpy as jnp
 
 from tpukit.ops.attention import causal_attention
 from tpukit.ops.layers import dropout, layer_norm, linear
+from tpukit.ops.moe_dispatch import moe_ffn_a2a, moe_ffn_xla
 
 Params = Any  # nested dict pytree of jax.Array
 
@@ -134,6 +135,16 @@ class GPTConfig:
     # style top-2. Gates stay the RAW router probabilities (GShard
     # convention) so top_k=1 is bit-identical to the Switch path.
     router_top_k: int = 1
+    # Expert dispatch dataflow (tpukit/ops/moe_dispatch.py). "xla": global
+    # one-hot einsums, partitioning left to GSPMD — the right spelling on
+    # one device / pure DP, and the default so the parity goldens and the
+    # single-chip bench path are untouched. "a2a": explicit shard_map
+    # dispatch — tokens pack into per-expert capacity buffers and move
+    # through a hand-placed lax.all_to_all pair over `moe_mesh`'s `expert`
+    # axis in BOTH forward and backward. ExpertParallel injects "a2a" (and
+    # the mesh) at loss time; plain model calls never see it.
+    moe_dispatch: str = "xla"  # "xla" | "a2a"
+    moe_mesh: Any = None  # jax Mesh with an 'expert' axis (a2a dispatch only)
 
     def __post_init__(self):
         if self.num_experts > 0 and not (1 <= self.router_top_k <= self.num_experts):
@@ -141,6 +152,10 @@ class GPTConfig:
                 f"router_top_k={self.router_top_k} must be in [1, "
                 f"num_experts={self.num_experts}] — silently clamping would "
                 f"train a different routing than the one requested"
+            )
+        if self.moe_dispatch not in ("xla", "a2a"):
+            raise ValueError(
+                f"moe_dispatch={self.moe_dispatch!r} must be 'xla' or 'a2a'"
             )
 
     @property
@@ -281,17 +296,16 @@ def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic, pad_mask=None):
     position — masking dispatch would change the FFN outputs and break
     the width-invariance contract below.
 
-    TPU-first design: STATIC shapes throughout — tokens dispatch into a
-    fixed `[E, B, capacity, dim]` buffer via one-hot einsums, each expert
-    runs the reference FFN (up -> relu -> down -> relu, the double-relu
-    quirk, models/gpt.py:33-41) as one batched matmul pair on the MXU, and
-    a transposed one-hot einsum combines the results scaled by the router
-    gate. Capacity is PER ROW (position within an expert = causal cumsum
-    of its assignment mask along the sequence), so rows never compete for
-    expert slots, and it derives from the STATIC max_position_embeddings —
-    not the call's sequence width — so a row's dispatch is identical
-    whatever buffer padding surrounds it: eval losses are
-    batch-composition-independent and the batched decode stays
+    TPU-first design: STATIC shapes throughout — tokens dispatch into
+    fixed capacity buffers, each expert runs the reference FFN (up -> relu
+    -> down -> relu, the double-relu quirk, models/gpt.py:33-41) as one
+    batched matmul pair on the MXU, and the gated combine returns results
+    to their residual positions. Capacity is PER ROW (position within an
+    expert = causal cumsum of its assignment mask along the sequence), so
+    rows never compete for expert slots, and it derives from the STATIC
+    max_position_embeddings — not the call's sequence width — so a row's
+    dispatch is identical whatever buffer padding surrounds it: eval
+    losses are batch-composition-independent and the batched decode stays
     token-for-token equal to the serial one even when their buffer widths
     differ. Tokens beyond an expert's row capacity get zero FFN output
     (they ride the residual stream). Router math is f32 (softmax stability
@@ -301,95 +315,18 @@ def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic, pad_mask=None):
     own capacity window, so a capacity-dropped token can differ from the
     full-reforward path there — use_cache=False is exact.
 
-    Under ExpertParallel (tpukit/shardings.py) the expert axis of the
-    buffers/kernels is sharded over the `expert` mesh axis and GSPMD turns
-    the dispatch/combine einsums into all_to_all-style collectives — the
-    NCCL all_to_all of GPU MoE frameworks, emitted from sharding specs.
+    The dispatch DATAFLOW is pluggable (cfg.moe_dispatch, implementations
+    in tpukit/ops/moe_dispatch.py): "xla" computes global one-hot
+    dispatch/combine einsums and leaves partitioning to GSPMD; "a2a" (what
+    ExpertParallel injects) hand-places the token exchange as a
+    lax.all_to_all pair over the `expert` mesh axis inside shard_map —
+    identical math, and the backward is also an all_to_all pair instead of
+    the GSPMD replicate-repartition fallback the einsum transpose provokes
+    (MULTICHIP_r05.json). Dropout applies to the combined output, outside
+    either dataflow, so the two stay loss/grad-parity-equal.
     """
-    batch, seq_len, dim = x.shape
-    experts = layer["ffn"]["experts"]
-    n_exp = cfg.num_experts
-    # Derived from the STATIC position-table size (width invariance) and
-    # scaled by the routed-experts count (top-k generates k*S assignments
-    # per row — the GShard convention; without the factor, top-2 would
-    # drop ~37% of second choices even at perfect balance), then clamped
-    # to the call width: a row position can never reach seq_len, so the
-    # clamp is output-identical while keeping short decode buffers from
-    # paying full-table-sized dispatch/combine einsums.
-    top_k = cfg.router_top_k
-    capacity = max(
-        1,
-        int(
-            -(-cfg.max_position_embeddings * top_k * cfg.expert_capacity_factor
-              // n_exp)
-        ),
-    )
-    capacity = min(capacity, seq_len)
-
-    xc = x.astype(cfg.compute_dtype)
-    logits = jnp.einsum(
-        "bsd,de->bse", x.astype(jnp.float32),
-        layer["ffn"]["router"]["kernel"].astype(jnp.float32),
-    )
-    probs = jax.nn.softmax(logits, axis=-1)  # [B, S, E] f32
-    top_vals, top_idx = jax.lax.top_k(probs, top_k)  # [B, S, K]
-    # per-(token, expert) assignment and raw-probability gates; the k
-    # chosen experts are distinct, so the one-hot sum stays 0/1-valued
-    choice_oh = jax.nn.one_hot(top_idx, n_exp, dtype=jnp.float32)  # [B, S, K, E]
-    assign = jnp.sum(choice_oh, axis=2)  # [B, S, E]
-    gate_map = jnp.sum(top_vals[..., None] * choice_oh, axis=2)  # [B, S, E]
-
-    # position of each token in its expert's per-row buffer (cumsum along
-    # the sequence is causal: later tokens never evict earlier ones);
-    # >= capacity drops
-    pos = jnp.cumsum(assign, axis=1) * assign - 1.0
-    kept = assign * (pos < capacity)
-    slot = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
-    dispatch = (
-        kept[..., None] * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
-    ).astype(cfg.compute_dtype)  # [B, S, E, C]
-
-    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, xc)
-    h = jnp.einsum(
-        "ebcd,edf->ebcf", expert_in, experts["up"]["kernel"].astype(cfg.compute_dtype)
-    ) + experts["up"]["bias"].astype(cfg.compute_dtype)[:, None, None, :]
-    h = jax.nn.relu(h)
-    h = jnp.einsum(
-        "ebcf,efd->ebcd", h, experts["down"]["kernel"].astype(cfg.compute_dtype)
-    ) + experts["down"]["bias"].astype(cfg.compute_dtype)[:, None, None, :]
-    h = jax.nn.relu(h)
-    # combine weighted by each (token, expert)'s gate — for top_k=1 this
-    # is the Switch combine exactly (one expert, raw top prob)
-    out = jnp.einsum(
-        "ebcd,bsec->bsd", h,
-        dispatch * gate_map.astype(cfg.compute_dtype)[..., None],
-    )
-
-    # Switch load-balance terms; /top_k keeps frac_tokens a distribution
-    # (each token contributes k assignments).
-    if pad_mask is not None and cfg.moe_aux_mask_pads:
-        # Switch convention (ADVICE r5 #2): statistics over REAL tokens
-        # only. Per-row normalization by the real-token count, and all-pad
-        # rows drop out of the batch mean entirely (their clamped
-        # denominator would otherwise contribute a spurious zero).
-        real = (~pad_mask).astype(jnp.float32)  # [B, S]
-        count = jnp.maximum(jnp.sum(real, axis=1), 1.0)  # [B]
-        frac_tokens = (
-            jnp.einsum("bse,bs->be", assign, real) / count[:, None] / top_k
-        )
-        mean_prob = jnp.einsum("bse,bs->be", probs, real) / count[:, None]
-        row_real = (jnp.sum(real, axis=1) > 0).astype(jnp.float32)  # [B]
-        aux = n_exp * jnp.sum(
-            jnp.sum(frac_tokens * mean_prob, axis=-1) * row_real
-        ) / jnp.maximum(jnp.sum(row_real), 1.0)
-    else:
-        # Pre-round-8 behavior (cfg.moe_aux_mask_pads=False, or call sites
-        # without a mask — the cached decode path): average over every
-        # position including pads. Kept selectable so pre-masking training
-        # curves stay reproducible.
-        frac_tokens = jnp.mean(assign, axis=1) / top_k  # [B, E]
-        mean_prob = jnp.mean(probs, axis=1)  # [B, E]
-        aux = n_exp * jnp.mean(jnp.sum(frac_tokens * mean_prob, axis=-1))
+    impl = moe_ffn_a2a if cfg.moe_dispatch == "a2a" else moe_ffn_xla
+    out, aux = impl(layer, cfg, x, pad_mask=pad_mask)
     return dropout(out, cfg.dropout, rng, deterministic), aux
 
 

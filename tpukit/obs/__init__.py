@@ -53,7 +53,10 @@ from tpukit.obs.watchdog import (  # noqa: F401
 )
 from tpukit.obs.xla import (  # noqa: F401
     COLLECTIVE_OPS,
+    INVOLUNTARY_REMAT,
+    capture_compiler_stderr,
     collective_bytes,
     compiled_stats,
+    count_involuntary_remat,
     live_memory_stats,
 )

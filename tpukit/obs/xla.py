@@ -20,6 +20,14 @@ is a first-order cost worth metering). Three captures:
     peak, limit) for the per-window HBM watermark line. Returns None on
     backends without the API (CPU).
 
+Round 10 adds the hand-scheduled-collective audit half:
+`capture_compiler_stderr()` (fd-level stderr capture — the channel XLA's
+C++ partitioner warnings arrive on) and `count_involuntary_remat()` (the
+`[SPMD] Involuntary full rematerialization` fallback, GSPMD's
+replicate-then-repartition last resort — the round-5 EP dispatch
+regression MULTICHIP_r05.json caught; zero is the bar for any step whose
+collectives are placed by hand).
+
 Everything here is best-effort: any backend that lacks an analysis returns
 None for that field rather than raising — telemetry must never take down a
 training run.
@@ -27,7 +35,11 @@ training run.
 
 from __future__ import annotations
 
+import contextlib
+import os
 import re
+import sys
+import tempfile
 
 import jax
 
@@ -113,6 +125,55 @@ def collective_bytes(hlo_text: str) -> dict[str, dict[str, int]]:
         rec["count"] += 1
         rec["bytes"] += _result_bytes(shape_str, op, is_start=start is not None)
     return out
+
+
+# The GSPMD partitioner's last-resort warning (spmd_partitioner.cc): it
+# could not move a tensor between two shardings efficiently, so it
+# REPLICATES the full tensor and re-partitions — for MoE dispatch that is
+# exactly the all-device traffic expert parallelism exists to avoid. The
+# round-5 EP dryrun hit this on the backward of the dispatch einsum
+# (MULTICHIP_r05.json); the a2a dispatch path must never trigger it.
+INVOLUNTARY_REMAT = "Involuntary full rematerialization"
+
+
+def count_involuntary_remat(text: str) -> int:
+    """Number of `[SPMD] Involuntary full rematerialization` warnings in a
+    compiler log / captured stderr — each one is a tensor GSPMD gave up on
+    and resolved by replicate-then-repartition. Zero is the bar for any
+    step whose collectives are hand-placed."""
+    return text.count(INVOLUNTARY_REMAT)
+
+
+@contextlib.contextmanager
+def capture_compiler_stderr():
+    """Capture OS-level stderr (fd 2) for the duration of the block — the
+    channel XLA's C++ partitioner warnings arrive on, which Python-level
+    sys.stderr redirection cannot see. Yields a dict whose "text" key holds
+    the captured output after the block exits; whatever was captured is
+    re-emitted to the real stderr so no diagnostics are swallowed.
+
+    Used to audit a compile for involuntary-remat warnings (the dryrun's
+    EP world, bench.py's moe_ep_comm probe, tests). Note: a compile served
+    from the persistent compilation cache emits no warnings either way —
+    the audit is meaningful on cold compiles.
+    """
+    sys.stderr.flush()
+    holder = {"text": ""}
+    saved = os.dup(2)
+    tmp = tempfile.TemporaryFile(mode="w+b")
+    try:
+        os.dup2(tmp.fileno(), 2)
+        yield holder
+    finally:
+        sys.stderr.flush()
+        os.dup2(saved, 2)
+        os.close(saved)
+        tmp.seek(0)
+        holder["text"] = tmp.read().decode("utf-8", errors="replace")
+        tmp.close()
+        if holder["text"]:
+            sys.stderr.write(holder["text"])
+            sys.stderr.flush()
 
 
 def _cost_analysis_dict(compiled) -> dict | None:

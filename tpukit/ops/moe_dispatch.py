@@ -1,0 +1,294 @@
+"""Mixture-of-experts expert dispatch/combine implementations.
+
+Two interchangeable dataflows sit behind `GPTConfig.moe_dispatch`; both
+compute the SAME math (routing, per-row capacity, expert FFN, gated
+combine, load-balance aux) so they are loss/grad-parity-equal and the
+parity goldens in tests/test_moe.py hold across either:
+
+  - "xla" (default): the original global one-hot einsum formulation.
+    Dispatch is `[B,S,E,C] x [B,S,D] -> [E,B,C,D]`, combine is the
+    transposed einsum. On one device (or pure DP) this is the fastest
+    spelling — everything is a batched matmul. Under ExpertParallel it
+    is also what GSPMD must partition, and the round-5 multichip dryrun
+    showed it CANNOT: the backward of the dispatch einsum
+    (`jvp(bsec,bsd->ebcd)/transpose`) makes the SPMD partitioner fall
+    back to "[SPMD] Involuntary full rematerialization" — it replicates
+    the tensor and re-partitions it, exactly the all-traffic pattern
+    expert parallelism exists to avoid (MULTICHIP_r05.json).
+
+  - "a2a": the explicit shard_map formulation for ExpertParallel.
+    Inside the per-device block each device packs its LOCAL rows into
+    `[E, C_local, D]` capacity buffers (laid out `[E, B_local, C, D]` —
+    C_local = B_local*C, the per-row capacity C of the xla path so token
+    dropping is identical), exchanges them with a hand-placed
+    `lax.all_to_all` over the `expert` mesh axis, runs the local expert
+    shard's FFN on `[E_local, ep*B_local, C, D]`, and returns results
+    with the mirrored all_to_all. No custom VJP is needed: the
+    formulation is symmetric — `lax.all_to_all`'s transpose is the
+    inverse all_to_all and the pack/combine einsums transpose to local
+    einsums — so the BACKWARD is also exactly one all_to_all pair per
+    layer, never a GSPMD replicate-repartition (asserted against the
+    optimized HLO in tests/test_moe.py and the multichip dryrun).
+
+Collectives are hand-scheduled rather than compiler-inferred — the core
+lesson of the collectives literature (PAPERS.md: "The Big Send-off",
+GC3). `expected_a2a` is the audit half: the closed-form per-device
+all-to-all payload the compiled HLO must show, consumed by fit()'s xla
+telemetry record, bench.py's `moe_ep_comm` probe and the dryrun audit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpukit.compat import shard_map
+
+
+def moe_capacity(cfg, seq_len: int) -> int:
+    """Per-row expert capacity. Derived from the STATIC position-table size
+    (width invariance: a row's dispatch is identical whatever buffer padding
+    surrounds it) scaled by the routed-experts count (top-k generates k*S
+    assignments per row — the GShard convention), then clamped to the call
+    width: a row position can never reach seq_len, so the clamp is
+    output-identical while keeping short decode buffers cheap."""
+    top_k = cfg.router_top_k
+    capacity = max(
+        1,
+        int(
+            -(-cfg.max_position_embeddings * top_k * cfg.expert_capacity_factor
+              // cfg.num_experts)
+        ),
+    )
+    return min(capacity, seq_len)
+
+
+def _route(x, router_kernel, cfg):
+    """Shared routing front half: top-k choice, gates, and the per-row
+    fixed-capacity dispatch one-hot. Row-local math — identical whether `x`
+    is the global batch (xla path) or one device's shard (a2a path).
+
+    Returns (xc, dispatch, gate_map, probs, assign):
+      xc       [B,S,D]  x in the compute dtype
+      dispatch [B,S,E,C] 0/1 (compute dtype): token (b,s) -> slot c of expert e
+      gate_map [B,S,E]  f32 raw router probability of each chosen expert
+      probs    [B,S,E]  f32 full softmax (aux statistics)
+      assign   [B,S,E]  f32 0/1 chosen-expert mask (aux statistics)
+    """
+    n_exp = cfg.num_experts
+    top_k = cfg.router_top_k
+    capacity = moe_capacity(cfg, x.shape[1])
+
+    xc = x.astype(cfg.compute_dtype)
+    # router math is f32 (softmax stability under bf16 compute)
+    logits = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32), router_kernel.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [B, S, E] f32
+    top_vals, top_idx = jax.lax.top_k(probs, top_k)  # [B, S, K]
+    # per-(token, expert) assignment and raw-probability gates; the k
+    # chosen experts are distinct, so the one-hot sum stays 0/1-valued
+    choice_oh = jax.nn.one_hot(top_idx, n_exp, dtype=jnp.float32)  # [B, S, K, E]
+    assign = jnp.sum(choice_oh, axis=2)  # [B, S, E]
+    gate_map = jnp.sum(top_vals[..., None] * choice_oh, axis=2)  # [B, S, E]
+
+    # position of each token in its expert's per-row buffer (cumsum along
+    # the sequence is causal: later tokens never evict earlier ones);
+    # >= capacity drops
+    pos = jnp.cumsum(assign, axis=1) * assign - 1.0
+    kept = assign * (pos < capacity)
+    slot = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    dispatch = (
+        kept[..., None] * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+    ).astype(cfg.compute_dtype)  # [B, S, E, C]
+    return xc, dispatch, gate_map, probs, assign
+
+
+def _expert_ffn(experts, expert_in, dtype):
+    """The reference FFN (up -> relu -> down -> relu, the double-relu quirk,
+    models/gpt.py:33-41) as batched matmuls over an expert-major buffer
+    `[E(,_local), b, C, D]`. Works on the full bank or one device's shard."""
+    h = jnp.einsum(
+        "ebcd,edf->ebcf", expert_in, experts["up"]["kernel"].astype(dtype)
+    ) + experts["up"]["bias"].astype(dtype)[:, None, None, :]
+    h = jax.nn.relu(h)
+    h = jnp.einsum(
+        "ebcf,efd->ebcd", h, experts["down"]["kernel"].astype(dtype)
+    ) + experts["down"]["bias"].astype(dtype)[:, None, None, :]
+    return jax.nn.relu(h)
+
+
+def _aux_stats(probs, assign, pad_mask, cfg):
+    """Switch load-balance statistics as a (numerator, denominator) pair of
+    row sums, so the a2a path can psum the pair across row shards and both
+    paths finish with `aux = E * num / max(den, 1)`.
+
+    With a pad_mask and cfg.moe_aux_mask_pads (the Switch convention,
+    ADVICE r5 #2): statistics over REAL tokens only, per-row normalization
+    by the real-token count, all-pad rows dropped from the mean. Otherwise:
+    the pre-round-8 any-position average (den = row count)."""
+    top_k = cfg.router_top_k
+    if pad_mask is not None and cfg.moe_aux_mask_pads:
+        real = (~pad_mask).astype(jnp.float32)  # [B, S]
+        count = jnp.maximum(jnp.sum(real, axis=1), 1.0)  # [B]
+        frac_tokens = (
+            jnp.einsum("bse,bs->be", assign, real) / count[:, None] / top_k
+        )
+        mean_prob = jnp.einsum("bse,bs->be", probs, real) / count[:, None]
+        row_real = (jnp.sum(real, axis=1) > 0).astype(jnp.float32)  # [B]
+        num = jnp.sum(jnp.sum(frac_tokens * mean_prob, axis=-1) * row_real)
+        den = jnp.sum(row_real)
+        return num, den
+    # any-position average (cfg.moe_aux_mask_pads=False, or call sites
+    # without a mask — the cached decode path), kept selectable so
+    # pre-masking training curves stay reproducible
+    frac_tokens = jnp.mean(assign, axis=1) / top_k  # [B, E]
+    mean_prob = jnp.mean(probs, axis=1)  # [B, E]
+    num = jnp.sum(jnp.sum(frac_tokens * mean_prob, axis=-1))
+    den = jnp.float32(assign.shape[0])
+    return num, den
+
+
+def moe_ffn_xla(layer, cfg, x, pad_mask=None):
+    """The einsum formulation: global one-hot dispatch/combine, partitioning
+    left to GSPMD. Returns (out [B,S,D], aux scalar). The right spelling on
+    one device and under pure data parallelism; see the module docstring for
+    why ExpertParallel routes around it."""
+    experts = layer["ffn"]["experts"]
+    xc, dispatch, gate_map, probs, assign = _route(
+        x, layer["ffn"]["router"]["kernel"], cfg
+    )
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, xc)
+    h = _expert_ffn(experts, expert_in, cfg.compute_dtype)
+    # combine weighted by each (token, expert)'s gate — for top_k=1 this
+    # is the Switch combine exactly (one expert, raw top prob)
+    out = jnp.einsum(
+        "ebcd,bsec->bsd", h,
+        dispatch * gate_map.astype(cfg.compute_dtype)[..., None],
+    )
+    num, den = _aux_stats(probs, assign, pad_mask, cfg)
+    aux = cfg.num_experts * num / jnp.maximum(den, 1.0)
+    return out, aux
+
+
+def moe_ffn_a2a(layer, cfg, x, pad_mask=None):
+    """The explicit shard_map formulation for ExpertParallel (see module
+    docstring). Requires `cfg.moe_mesh` (the strategy's `(data?, expert)`
+    mesh — ExpertParallel.loss_fn injects it alongside moe_dispatch="a2a").
+
+    Per-device block: route local rows -> pack `[E, B_local, C, D]` ->
+    all_to_all over `expert` -> local expert shard FFN on
+    `[E_local, ep*B_local, C, D]` -> mirrored all_to_all -> gated local
+    combine. The aux statistics are local row sums psummed over the row
+    axes, so the scalar matches the global formula. Degenerate axes
+    (expert mesh size 1) skip the collective but keep the same block, so
+    single-group meshes still share one code path."""
+    mesh = cfg.moe_mesh
+    if mesh is None:
+        raise ValueError(
+            "moe_dispatch='a2a' needs cfg.moe_mesh (a mesh with an 'expert' "
+            "axis) — ExpertParallel injects it; set moe_dispatch='xla' for "
+            "meshless execution"
+        )
+    if "expert" not in mesh.axis_names:
+        raise ValueError(
+            f"moe_dispatch='a2a' needs an 'expert' axis in cfg.moe_mesh, "
+            f"got axes {mesh.axis_names}"
+        )
+    ep = mesh.shape["expert"]
+    if cfg.num_experts % ep:
+        raise ValueError(
+            f"num_experts {cfg.num_experts} must divide over the {ep}-way "
+            f"expert mesh axis for a2a dispatch"
+        )
+    # rows shard over every available mesh axis — ExpertParallel.batch_spec
+    row_axes = tuple(a for a in ("data", "expert") if a in mesh.axis_names)
+    x_spec = P(row_axes, None, None)
+    mask_spec = P(row_axes, None)
+    has_mask = pad_mask is not None
+    mask_arr = pad_mask if has_mask else jnp.zeros(x.shape[:2], bool)
+
+    def block(x_l, mask_l, router_kernel, experts_l):
+        xc, dispatch, gate_map, probs, assign = _route(x_l, router_kernel, cfg)
+        # pack local rows into per-expert capacity buffers [E, B_local, C, D]
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, xc)
+        if ep > 1:
+            # exchange: send the expert-block destined for peer j, receive
+            # every peer's block for OUR experts -> [E_local, ep*B_local, C, D]
+            expert_in = jax.lax.all_to_all(
+                expert_in, "expert", split_axis=0, concat_axis=1, tiled=True
+            )
+        h = _expert_ffn(experts_l, expert_in, cfg.compute_dtype)
+        if ep > 1:
+            # mirrored return trip -> [E, B_local, C, D] back on the source
+            h = jax.lax.all_to_all(
+                h, "expert", split_axis=1, concat_axis=0, tiled=True
+            )
+        out = jnp.einsum(
+            "ebcd,bsec->bsd", h,
+            dispatch * gate_map.astype(cfg.compute_dtype)[..., None],
+        )
+        num, den = _aux_stats(probs, assign, mask_l if has_mask else None, cfg)
+        num = jax.lax.psum(num, row_axes)
+        den = jax.lax.psum(den, row_axes)
+        aux = cfg.num_experts * num / jnp.maximum(den, 1.0)
+        return out, aux
+
+    out, aux = shard_map(
+        block,
+        mesh=mesh,
+        in_specs=(x_spec, mask_spec, P(), P("expert")),
+        out_specs=(x_spec, P()),
+        check_vma=False,
+    )(x, mask_arr, layer["ffn"]["router"]["kernel"], layer["ffn"]["experts"])
+    return out, aux
+
+
+def expected_a2a(cfg, data_size: int, expert_size: int, global_batch: int,
+                 seq: int) -> dict | None:
+    """Closed-form per-device all-to-all payload of the a2a dispatch — what
+    the optimized HLO of one step must show (the audit side of
+    hand-scheduling the collective).
+
+    Per layer each device moves its `[E, B_local, C, D]` buffer out and the
+    results back: 2 all_to_alls forward, and — because the formulation is
+    its own transpose — exactly 2 more in the backward (6 with
+    cfg.remat_layers: the checkpointed forward re-runs). Counts are HLO *op
+    instances*: the scanned layer stack (cfg.scan_layers) emits each op
+    once in the scan body regardless of depth, so `layers_visible` is 1
+    there. A 1-way expert axis moves nothing (the block skips the
+    collective). Returns {"buffer_bytes", "train": {count, bytes},
+    "eval": {count, bytes}} — eval uses bf16 (the always-on eval autocast)
+    and is forward-only."""
+    if cfg.num_experts <= 0:
+        return None
+    zero = {"count": 0, "bytes": 0}
+    if expert_size <= 1:
+        return {"buffer_bytes": 0, "train": dict(zero), "eval": dict(zero)}
+    capacity = moe_capacity(cfg, seq)
+    rows = data_size * expert_size
+    if global_batch % rows:
+        return None  # undividable batch never reaches the a2a path
+    b_local = global_batch // rows
+    layers_visible = 1 if cfg.scan_layers else cfg.num_layers
+    train_ops = 6 if cfg.remat_layers else 4
+
+    def bytes_for(dtype, ops_per_layer):
+        buf = (
+            cfg.num_experts * b_local * capacity * cfg.dim
+            * jnp.dtype(dtype).itemsize
+        )
+        return {
+            "count": ops_per_layer * layers_visible,
+            "bytes": ops_per_layer * layers_visible * buf,
+        }
+
+    return {
+        "buffer_bytes": (
+            cfg.num_experts * b_local * capacity * cfg.dim
+            * jnp.dtype(cfg.compute_dtype).itemsize
+        ),
+        "train": bytes_for(cfg.compute_dtype, train_ops),
+        "eval": bytes_for(jnp.bfloat16, 2),
+    }

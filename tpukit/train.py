@@ -645,9 +645,23 @@ def _fit_body(
             stats = compiled_stats(jitted, *structs)
         if stats:
             expected = getattr(strategy, "comm_ops", ())
+            extra = {}
+            # Hand-scheduled dispatch audit (round 10): strategies that
+            # place their own collectives (ExpertParallel's a2a MoE
+            # dispatch) predict the per-device all-to-all payload in
+            # closed form; the record carries it next to the measured HLO
+            # bytes so tools/report.py can flag a dispatch regression.
+            audit_fn = getattr(strategy, "dispatch_comm", None)
+            if audit_fn is not None:
+                ids = call_args[1]["input_ids"]
+                audit = audit_fn(cfg, global_batch=ids.shape[0], seq=ids.shape[1])
+                if audit:
+                    key = "train" if fn_name == "train_step" else "eval"
+                    extra["a2a_expected"] = audit[key]
             logger.log(
                 kind="xla", fn=fn_name, strategy=strategy.name,
-                expected_comm_ops=list(expected), **stats,
+                backend=jax.default_backend(),
+                expected_comm_ops=list(expected), **extra, **stats,
             )
 
     epochs = num_epochs if num_epochs is not None else flags.epochs

@@ -19,6 +19,10 @@ depth-2 overlap, reporting the input-share both ways and loss parity. The
 bare loop, with the same loss-parity proof. The `moe_ep_comm` record
 (round 10) audits the ExpertParallel a2a dispatch: expected-vs-measured
 all-to-all bytes, involuntary-remat warning count, a2a-path throughput.
+The `moe_dispatch_ladder` record (round 11, ROADMAP #3) measures the
+three MoE dataflows — xla buffers, a2a exchange, pallas grouped GEMM — at
+e8 top-1/top-2 with active-FLOPs-normalized MFU; `--moe_dispatch pallas`
+flips the headline moe_e8 probe onto the kernel path.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -272,6 +276,76 @@ def bench_moe_ep_comm(cfg, n_dev, num_experts=8, steps=8):
     }
 
 
+def bench_moe_dispatch_ladder(cfg, n_dev, num_experts=8, steps=8):
+    """FLOP-normalized MoE dispatch ladder (ROADMAP #3, round 11): xla vs
+    a2a vs pallas at the e8 shape, top-1 AND top-2. Each rung reports
+    tokens/s/chip and an MFU normalized by ACTIVE FLOPs
+    (`obs.moe_active_flops_per_token`: top_k routed experts + router per
+    token — the dropless convention), so a dataflow that burns MXU cycles
+    on capacity padding or one-hot dispatch einsums shows as LOST MFU at
+    equal tokens/s instead of hiding inside a bigger FLOP count. "xla" and
+    "pallas" run meshless (the single-chip spellings); "a2a" runs through
+    ExpertParallel, whose 1-way expert axis on one chip keeps the same
+    capacity-buffer dataflow without collectives. Per-rung failures land
+    as {"dispatch", "top_k", "error"} entries — a broken rung cannot hide
+    behind a clean rc=0."""
+    import math
+
+    from tools.bench_ladder import make_batch, setup_step, time_windows
+    from tpukit.mesh import create_mesh
+    from tpukit.obs import moe_active_flops_per_token, peak_flops_per_chip
+    from tpukit.shardings import DataParallel, ExpertParallel, SingleDevice
+
+    seq = cfg.max_position_embeddings
+    batch = 32 * n_dev
+    peak = peak_flops_per_chip()
+    rows = []
+    for top_k in (1, 2):
+        for dispatch in ("xla", "a2a", "pallas"):
+            cfg_m = cfg.replace(num_experts=num_experts, router_top_k=top_k)
+            try:
+                if dispatch == "a2a":
+                    expert = math.gcd(n_dev, num_experts)
+                    strat = ExpertParallel(
+                        create_mesh(
+                            {"data": n_dev // expert, "expert": expert}
+                        ),
+                        dispatch="a2a",
+                    )
+                else:
+                    cfg_m = cfg_m.replace(moe_dispatch=dispatch)
+                    strat = DataParallel() if n_dev > 1 else SingleDevice()
+                step, state, _, _ = setup_step(cfg_m, strat)
+                b, t = make_batch(
+                    np.random.RandomState(5), cfg.vocab_size, batch, seq - 1
+                )
+                times, state, loss = time_windows(
+                    step, state, b, t, steps=steps, windows=3, warmup=2
+                )
+                del state
+                tps_chip = steps * batch * (seq - 1) / min(times) / n_dev
+                flops = moe_active_flops_per_token(cfg_m, seq - 1)
+                rows.append({
+                    "dispatch": dispatch,
+                    "top_k": top_k,
+                    "tokens_per_sec_per_chip": round(tps_chip, 1),
+                    "active_flops_per_token": flops,
+                    "mfu_active": (
+                        round(tps_chip * flops / peak, 4) if peak else None
+                    ),
+                    "final_loss": round(loss, 6),
+                })
+            except Exception as exc:
+                rows.append(
+                    {"dispatch": dispatch, "top_k": top_k, "error": repr(exc)}
+                )
+                print(
+                    f"moe ladder rung {dispatch}/top{top_k} failed: {exc!r}",
+                    file=sys.stderr,
+                )
+    return rows
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -279,6 +353,14 @@ def main(argv=None):
         default=os.environ.get("TPUKIT_COMPILE_CACHE_DIR", ".jax_cache"),
         help="persistent XLA compile cache ('' disables); repeat runs skip "
         "recompiles and the JSON reports hits/misses",
+    )
+    ap.add_argument(
+        "--moe_dispatch",
+        choices=("xla", "pallas"),
+        default="xla",
+        help="dataflow for the headline moe_e8 probe (default xla so the "
+        "number stays comparable across rounds; the moe_dispatch_ladder "
+        "record always measures xla, a2a and pallas side by side)",
     )
     args = ap.parse_args(argv)
 
@@ -401,7 +483,7 @@ def main(argv=None):
     # einsums + aux loss + AdamW).
     moe_tps, moe_err = None, None
     try:
-        cfg_moe = cfg.replace(num_experts=8)
+        cfg_moe = cfg.replace(num_experts=8, moe_dispatch=args.moe_dispatch)
         step_m, state_m, _, _ = setup_step(cfg_moe, strategy)
         moe_batch = 32 * n_dev
         b_m, t_m = make_batch(rng, cfg.vocab_size, moe_batch, seq - 1)
@@ -424,6 +506,16 @@ def main(argv=None):
     except Exception as exc:
         moe_ep_comm_err = repr(exc)
         print(f"moe ep comm probe failed: {exc!r}", file=sys.stderr)
+
+    # MoE dispatch ladder (round 11, ROADMAP #3): xla vs a2a vs pallas at
+    # e8 top-1/top-2, tokens/s/chip + active-FLOPs-normalized MFU. Per-rung
+    # errors land inside the record itself.
+    moe_dispatch_ladder = None
+    try:
+        moe_dispatch_ladder = bench_moe_dispatch_ladder(cfg, n_dev)
+    except Exception as exc:
+        moe_dispatch_ladder = [{"dispatch": "ladder", "error": repr(exc)}]
+        print(f"moe dispatch ladder failed: {exc!r}", file=sys.stderr)
 
     # Host input pipeline (round 7): sync data+h2d share vs the depth-2
     # prefetcher's residual stall share, with loss-parity proof.
@@ -473,9 +565,11 @@ def main(argv=None):
         "fsdp_cpu_offload_tokens_per_sec_per_chip": round(offload_tps, 1) if offload_tps else None,
         "fsdp_cpu_offload_error": offload_err,
         "moe_e8_tokens_per_sec_per_chip": round(moe_tps, 1) if moe_tps else None,
+        "moe_e8_dispatch": args.moe_dispatch,
         "moe_error": moe_err,
         "moe_ep_comm": moe_ep_comm,
         "moe_ep_comm_error": moe_ep_comm_err,
+        "moe_dispatch_ladder": moe_dispatch_ladder,
         "host_pipeline": host_pipeline,
         "host_pipeline_error": host_pipeline_err,
         "obs_overhead": obs_overhead,

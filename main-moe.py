@@ -12,9 +12,13 @@ axis, the dense trunk + its Adam moments FSDP-style over `data`, and —
 with the default `--moe_dispatch a2a` — moves tokens through hand-placed
 `lax.all_to_all` pairs inside shard_map (tpukit/ops/moe_dispatch.py), the
 collectives GPU MoE frameworks hand-write with NCCL, in both the forward
-and the backward. `--moe_dispatch xla` restores the round-5
-einsum-and-GSPMD dispatch for comparison (its backward degrades to a
-replicate-repartition; see tpukit/shardings.py ExpertParallel).
+and the backward. `--moe_dispatch pallas` keeps that exchange but runs
+the expert FFN through the fused grouped-expert segment GEMM
+(tpukit/ops/moe_gemm.py) — and on a single chip it is the dropless
+sorted dataflow with no capacity buffer at all. `--moe_dispatch xla`
+restores the round-5 einsum-and-GSPMD dispatch for comparison (its
+backward degrades to a replicate-repartition; see tpukit/shardings.py
+ExpertParallel).
 
 The device grid puts `expert` innermost (its all_to_alls ride the fastest
 ICI links) with remaining devices data-parallel, e.g. 8 devices and 8

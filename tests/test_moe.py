@@ -61,13 +61,14 @@ def _one_step(strategy, cfg, batch, targets):
     return jax.device_get(new_state.params), float(loss), float(eval_loss), float(eval_acc)
 
 
-@pytest.mark.parametrize("dispatch", ["xla", "a2a"])
+@pytest.mark.parametrize("dispatch", ["xla", "a2a", "pallas"])
 def test_ep_matches_single(cfg, batch, dispatch):
     """The whole point: expert-sharded execution is the same math. One full
     train step (fwd + bwd incl. the aux loss + AdamW) through the
     (data=2, expert=4) mesh must match the single-device MoE step — for
-    BOTH dispatch dataflows (the GSPMD einsums and the explicit shard_map
-    all_to_all of tpukit/ops/moe_dispatch.py)."""
+    ALL dispatch dataflows (the GSPMD einsums, the explicit shard_map
+    all_to_all of tpukit/ops/moe_dispatch.py, and the a2a exchange with
+    the Pallas grouped GEMM of tpukit/ops/moe_gemm.py)."""
     model_batch, targets = batch
     ref = _one_step(SingleDevice(), cfg, model_batch, targets)
     ep = _one_step(
@@ -82,11 +83,11 @@ def test_ep_matches_single(cfg, batch, dispatch):
     )
 
 
-@pytest.mark.parametrize("dispatch", ["xla", "a2a"])
+@pytest.mark.parametrize("dispatch", ["xla", "a2a", "pallas"])
 def test_ep_top2_matches_single(cfg, batch, dispatch):
     """GShard/Mixtral-style top-2 routing holds the same EP-vs-single
     parity bar as top-1 (distinct experts per token, per-expert gates),
-    on both dispatch dataflows."""
+    on all three dispatch dataflows."""
     model_batch, targets = batch
     cfg2 = cfg.replace(router_top_k=2)
     ref = _one_step(SingleDevice(), cfg2, model_batch, targets)
@@ -393,21 +394,25 @@ def test_moe_generation_batched_matches_serial(cfg):
     assert batched == serial
 
 
-def test_ep_a2a_hlo_audit(cfg, batch):
-    """The tentpole's proof obligations, against the compiled artifact:
-    the a2a-dispatch EP train step's optimized HLO contains the all-to-all
-    dispatch/combine pair for every layer — in the BACKWARD too (count
-    4 x layers: fwd dispatch+combine and their transposes) — at exactly
-    the closed-form byte count `ExpertParallel.dispatch_comm` predicts,
-    and its compile emits ZERO `[SPMD] Involuntary full rematerialization`
-    warnings (the round-5 einsum dispatch emitted them on every backward;
-    MULTICHIP_r05.json)."""
+@pytest.mark.parametrize("dispatch", ["a2a", "pallas"])
+def test_ep_a2a_hlo_audit(cfg, batch, dispatch):
+    """The round-10/11 proof obligations, against the compiled artifact:
+    the a2a- and pallas-dispatch EP train steps' optimized HLO contains
+    the all-to-all dispatch/combine pair for every layer — in the BACKWARD
+    too (count 4 x layers: fwd dispatch+combine and their transposes) — at
+    exactly the closed-form byte count `ExpertParallel.dispatch_comm`
+    predicts, and the compile emits ZERO `[SPMD] Involuntary full
+    rematerialization` warnings (the round-5 einsum dispatch emitted them
+    on every backward; MULTICHIP_r05.json). Running BOTH dispatches
+    through one audit asserts the round-11 kernel path changed the
+    on-device FFN spelling without touching the collective schedule — the
+    "unchanged a2a byte audit" acceptance bar."""
     from tpukit.obs.xla import (
         capture_compiler_stderr, collective_bytes, count_involuntary_remat,
     )
 
     model_batch, targets = batch
-    strategy = ExpertParallel(create_mesh({"data": 2, "expert": 4}), dispatch="a2a")
+    strategy = ExpertParallel(create_mesh({"data": 2, "expert": 4}), dispatch=dispatch)
     opt = make_optimizer(1e-3)
     state = create_train_state(jax.random.PRNGKey(0), cfg, opt, strategy)
     shapes = jax.eval_shape(lambda: state)
@@ -430,6 +435,147 @@ def test_ep_a2a_hlo_audit(cfg, batch):
     # backend upcasts to f32 — on TPU the bytes match expect["eval"].
     ea2a = collective_bytes(ecompiled.as_text()).get("all-to-all")
     assert ea2a is not None and ea2a["count"] == expect["eval"]["count"] == 2 * cfg.num_layers
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_pallas_matches_xla_loss_grad(cfg, batch, top_k):
+    """Round-11 acceptance: loss AND gradient parity of the dropless
+    pallas grouped-GEMM dataflow vs the xla buffers at dense tolerance,
+    top-1 and top-2, on the CPU interpret path. `moe_capacity=SEQ` pins
+    both sides to the same (no-drop) token set — the xla buffer can hold
+    every assignment, the pallas path is dropless by construction — so the
+    only difference left is the dataflow itself."""
+    model_batch, targets = batch
+    base = cfg.replace(router_top_k=top_k, moe_capacity=SEQ)
+    strategy = SingleDevice()
+    params = init_params(jax.random.PRNGKey(0), base)
+    loss_x, grads_x = strategy.value_and_grad(params, base, model_batch, targets)
+    loss_p, grads_p = strategy.value_and_grad(
+        params, base.replace(moe_dispatch="pallas"), model_batch, targets
+    )
+    assert abs(float(loss_x) - float(loss_p)) < 1e-5
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=1e-4
+        ),
+        grads_x, grads_p,
+    )
+    # the kernel path really ran: its gradient reaches every expert AND
+    # the router (the custom VJP wires dW, dX and the gate path)
+    assert float(jnp.max(jnp.abs(
+        grads_p["layers"]["ffn"]["experts"]["up"]["kernel"]
+    ))) > 0.0
+    assert float(jnp.max(jnp.abs(
+        grads_p["layers"]["ffn"]["router"]["kernel"]
+    ))) > 0.0
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_pallas_drop_semantics(cfg, top_k):
+    """Satellite regression: with `moe_capacity` forcing drops, the pallas
+    path drops EXACTLY the token set the xla buffers drop (bit-identical
+    kept mask AND matching outputs), and in dropless mode (moe_capacity=0)
+    it drops none — every routed assignment computes."""
+    from tpukit.ops.moe_dispatch import _route
+    from tpukit.ops.moe_gemm import pallas_kept_mask
+
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(3, SEQ, cfg.dim).astype(np.float32))
+    tight = cfg.replace(router_top_k=top_k, moe_capacity=2)
+    params = init_params(jax.random.PRNGKey(0), tight)
+    layer0 = jax.tree.map(lambda t: t[0], params["layers"])
+    router = layer0["ffn"]["router"]["kernel"]
+
+    # the kept sets are the SAME mask, bit for bit
+    _, dispatch, _, _, assign = _route(x, router, tight)
+    kept_xla = np.asarray(jnp.sum(dispatch, axis=-1))  # [B, S, E] 0/1
+    kept_pal = np.asarray(pallas_kept_mask(tight, x, router))
+    np.testing.assert_array_equal(kept_pal, kept_xla)
+    assert kept_xla.sum() < np.asarray(assign).sum(), (
+        "fixture must actually force drops"
+    )
+
+    # and the outputs agree under that shared drop set
+    out_x, _ = _apply_moe_ffn(layer0, tight, x, None, True)
+    out_p, _ = _apply_moe_ffn(
+        layer0, tight.replace(moe_dispatch="pallas"), x, None, True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_x), np.asarray(out_p), atol=1e-5
+    )
+    if top_k == 1:
+        # top-1 dropped tokens are exact-zero rows (residual passthrough)
+        # on BOTH paths — the zero patterns must coincide
+        zx = np.all(np.asarray(out_x) == 0.0, axis=-1)
+        zp = np.all(np.asarray(out_p) == 0.0, axis=-1)
+        np.testing.assert_array_equal(zx, zp)
+        assert zx.any()
+
+    # dropless mode: every routed assignment is kept, and the output
+    # equals the xla path given a buffer big enough to never drop
+    free = cfg.replace(router_top_k=top_k, moe_dispatch="pallas")
+    kept_free = np.asarray(pallas_kept_mask(free, x, router))
+    np.testing.assert_array_equal(kept_free, np.asarray(assign))
+    out_free, _ = _apply_moe_ffn(layer0, free, x, None, True)
+    out_ample, _ = _apply_moe_ffn(
+        layer0, cfg.replace(router_top_k=top_k, moe_capacity=SEQ), x, None, True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_free), np.asarray(out_ample), atol=1e-5
+    )
+
+
+def test_grouped_ffn_kernel_unit():
+    """The segment-GEMM kernel against a per-segment jnp reference —
+    forward values and all five cotangents through the custom VJP — on an
+    adversarial segment layout: uneven sizes, an empty expert, a segment
+    spanning a block boundary, and a sort-padding tail folded into the
+    last segment (whose cotangent must stay exactly zero)."""
+    from tpukit.ops import moe_gemm
+    from tpukit.ops.moe_gemm import grouped_ffn
+
+    e, d, f, n = 4, 32, 64, 250
+    bt, m = moe_gemm._plan_rows(n)
+    rng = np.random.RandomState(0)
+    xs = jnp.asarray(rng.randn(m, d).astype(np.float32))
+    wu = jnp.asarray(rng.randn(e, d, f).astype(np.float32) * 0.1)
+    bu = jnp.asarray(rng.randn(e, f).astype(np.float32) * 0.1)
+    wd = jnp.asarray(rng.randn(e, f, d).astype(np.float32) * 0.1)
+    bd = jnp.asarray(rng.randn(e, d).astype(np.float32) * 0.1)
+    sizes = [50, 3, 0, n - 53]
+    offs = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int32)
+    offs[-1] = m  # padding tail rides the last segment
+    offsets = jnp.asarray(offs)
+    cot = jnp.asarray(rng.randn(m, d).astype(np.float32))
+    cot = cot.at[n:].set(0.0)  # padding rows never receive cotangent
+
+    def ref(xs, wu, bu, wd, bd):
+        outs = []
+        bounds = [0] + list(np.cumsum(sizes))
+        for i in range(e):
+            s, t = bounds[i], bounds[i + 1]
+            h = jnp.maximum(xs[s:t] @ wu[i] + bu[i], 0.0)
+            outs.append(jnp.maximum(h @ wd[i] + bd[i], 0.0))
+        outs.append(xs[n:] * 0.0)  # padding rows: ignored either way
+        return jnp.concatenate(outs, axis=0)
+
+    y = grouped_ffn(xs, wu, bu, wd, bd, offsets)
+    y_ref = ref(xs, wu, bu, wd, bd)
+    np.testing.assert_allclose(
+        np.asarray(y)[:n], np.asarray(y_ref)[:n], atol=1e-5
+    )
+
+    loss_k = lambda *a: jnp.sum(grouped_ffn(*a, offsets) * cot)  # noqa: E731
+    loss_r = lambda *a: jnp.sum(ref(*a) * cot)  # noqa: E731
+    gk = jax.grad(loss_k, argnums=(0, 1, 2, 3, 4))(xs, wu, bu, wd, bd)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2, 3, 4))(xs, wu, bu, wd, bd)
+    for name, a, b in zip(("dx", "dwu", "dbu", "dwd", "dbd"), gk, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, err_msg=name
+        )
+    # padding-row dx is exactly zero: the tail contributes nothing to dW
+    # and receives nothing back
+    np.testing.assert_array_equal(np.asarray(gk[0][n:]), 0.0)
 
 
 def test_count_involuntary_remat():
@@ -460,6 +606,11 @@ def test_ep_dispatch_validation(cfg):
         ExpertParallel(create_mesh({"expert": 4}), dispatch="nccl")
     with pytest.raises(ValueError, match="moe_dispatch"):
         GPTConfig(num_experts=4, moe_dispatch="bogus")
+    # the round-11 kernel dispatch is a first-class citizen of both gates
+    assert GPTConfig(num_experts=4, moe_dispatch="pallas").moe_dispatch == "pallas"
+    assert ExpertParallel(
+        create_mesh({"expert": 4}), dispatch="pallas"
+    ).dispatch == "pallas"
 
     params = init_params(jax.random.PRNGKey(0), cfg)
     layer0 = jax.tree.map(lambda t: t[0], params["layers"])
@@ -478,6 +629,8 @@ def test_moe_dispatch_flag_plumbing():
     assert flags.moe_dispatch == "a2a"
     flags = parse_flags(["--moe_dispatch", "xla"], num_experts=True)
     assert flags.moe_dispatch == "xla"
+    flags = parse_flags(["--moe_dispatch", "pallas"], num_experts=True)
+    assert flags.moe_dispatch == "pallas"
     # non-MoE recipes don't grow the flag but keep the dataclass default
     assert parse_flags([]).moe_dispatch == "a2a"
 

@@ -428,24 +428,35 @@ def test_global_real_row_counts_agrees_with_iterated_real_rows():
 # ---------------------------------------------------------------------------
 
 
-def test_generate_use_cache_with_temperature_raises(tiny_config, tiny_params):
+def test_generate_use_cache_with_temperature_samples(tiny_config, tiny_params):
+    """Round 11 (ROADMAP #1 first rung): the cached decode loop implements
+    temperature sampling — the explicit use_cache=True + temperature>0
+    combination that raised through round 10 (VERDICT r5 #5) now decodes,
+    reproducibly under a fixed seed. Token-level cached-vs-uncached
+    same-seed equivalence lives in tests/test_sampling.py."""
     from tpukit.data import get_tokenizer
     from tpukit.sampling import generate
 
     tok = get_tokenizer()
-    with pytest.raises(ValueError, match="greedy-only"):
-        generate(
-            tiny_params, tiny_config, "The big brown cat ", tok,
-            use_cache=True, temperature=0.7,
-        )
+    a = generate(
+        tiny_params, tiny_config, "The big brown cat ", tok,
+        max_new_tokens=6, use_cache=True, temperature=0.7, seed=3,
+    )
+    b = generate(
+        tiny_params, tiny_config, "The big brown cat ", tok,
+        max_new_tokens=6, use_cache=True, temperature=0.7, seed=3,
+    )
+    assert isinstance(a, str) and a == b
 
 
-def test_generate_auto_cache_with_temperature_downgrades(
+def test_generate_auto_cache_with_temperature_uses_cached_loop(
     tiny_config, tiny_params, monkeypatch
 ):
-    """Only an EXPLICIT use_cache=True raises: when the long-buffer
-    heuristic auto-resolves use_cache (caller passed None), sampling must
-    silently route to the re-forward loop as before (r5 #4 regression)."""
+    """The long-buffer heuristic no longer downgrades sampling runs: with
+    use_cache auto-resolved (caller passed None) and a >=512-token buffer,
+    temperature>0 routes to the CACHED loop with the temperature intact
+    (through round 10 it silently fell back to the O(S^2) re-forward loop
+    because the cached loop was greedy-only)."""
     import tpukit.sampling as sampling
     from tpukit.data import get_tokenizer
 
@@ -454,18 +465,17 @@ def test_generate_auto_cache_with_temperature_downgrades(
     def fake_loop(params, cfg, buf, prompt_len, max_new, eos,
                   temperature=0.0, top_k=0, rng=None):
         seen["temperature"] = temperature
+        seen["has_rng"] = rng is not None
         return buf, np.int32(int(prompt_len))
 
-    monkeypatch.setattr(sampling, "_decode_loop", fake_loop)
+    monkeypatch.setattr(sampling, "_decode_loop_cached", fake_loop)
     cfg = tiny_config.replace(max_position_embeddings=1024)
     tok = get_tokenizer()
-    # buffer = prompt + 600 >= 512 tokens -> the heuristic would pick the
-    # cached loop; with temperature it must fall back, not raise
     out = sampling.generate(
         tiny_params, cfg, "The big brown cat ", tok,
         max_new_tokens=600, temperature=0.7,
     )
-    assert seen["temperature"] == 0.7
+    assert seen["temperature"] == 0.7 and seen["has_rng"]
     assert isinstance(out, str)
 
 

@@ -20,9 +20,10 @@ checkpoints), "preempt" (graceful SIGTERM/SIGINT checkpoint-and-exit),
 (the fault-injection audit trail). Round-10 expert parallelism adds an
 all-to-all dispatch audit line to the "xla" section (the strategy's
 closed-form payload vs the compiled HLO's) and renders bench.py's
-`moe_ep_comm` record when pointed at a bench JSON. This tool needs
-NOTHING but the file — no jax import, so it runs anywhere the log was
-copied to.
+`moe_ep_comm` record when pointed at a bench JSON; round 11 renders the
+`moe_dispatch_ladder` record (xla vs a2a vs pallas at e8 top-1/top-2,
+active-FLOPs-normalized MFU — ROADMAP #3). This tool needs NOTHING but
+the file — no jax import, so it runs anywhere the log was copied to.
 
 Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
 """
@@ -339,6 +340,25 @@ def summarize(records: list[dict]) -> str:
         if warns is not None:
             w(f"  involuntary-remat warnings at compile: {warns}"
               + ("" if warns == 0 else "  <- GSPMD replicate-repartition!"))
+    # round-11 dispatch ladder (ROADMAP #3): the three MoE dataflows side
+    # by side at e8 top-1/top-2, MFU normalized by ACTIVE FLOPs (top_k
+    # experts + router per token) so padding/dispatch waste reads as lost
+    # MFU rather than inflating the FLOP count.
+    for r in records:
+        ladder = r.get("moe_dispatch_ladder")
+        if not isinstance(ladder, list) or not ladder:
+            continue
+        w("== moe dispatch ladder (bench, active-FLOPs MFU) ==")
+        for row in ladder:
+            if "error" in row:
+                w(f"  {row.get('dispatch', '?'):<7} top{row.get('top_k', '?')}"
+                  f"  ERROR {row['error']}")
+                continue
+            mfu_a = row.get("mfu_active")
+            w(f"  {row['dispatch']:<7} top{row['top_k']}  "
+              f"{human_count(row.get('tokens_per_sec_per_chip'))} tok/s/chip"
+              + (f"   active-FLOPs MFU {mfu_a * 100:.1f}%"
+                 if mfu_a is not None else ""))
     return "\n".join(out)
 
 

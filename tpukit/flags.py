@@ -158,12 +158,16 @@ class TrainFlags:
     # routes to (1 = Switch, 2 = GShard/Mixtral-style top-2).
     num_experts: int = 0
     moe_top_k: int = 1
-    # main-moe.py only: expert dispatch dataflow (round 10). "a2a" (default)
-    # hand-places the token exchange as a shard_map lax.all_to_all pair over
-    # the `expert` mesh axis — forward AND backward — instead of leaving the
-    # dispatch einsums to GSPMD, whose backward falls into involuntary
-    # replicate-repartition (MULTICHIP_r05.json). "xla" restores the
-    # round-5 einsum-and-GSPMD behavior for comparison.
+    # main-moe.py only: expert dispatch dataflow (round 10/11). "a2a"
+    # (default) hand-places the token exchange as a shard_map
+    # lax.all_to_all pair over the `expert` mesh axis — forward AND
+    # backward — instead of leaving the dispatch einsums to GSPMD, whose
+    # backward falls into involuntary replicate-repartition
+    # (MULTICHIP_r05.json). "pallas" keeps that exchange but computes the
+    # expert FFN with the fused grouped-expert GEMM (tpukit/ops/
+    # moe_gemm.py; on one chip it is the dropless sorted segment GEMM —
+    # the moe_e8 throughput path). "xla" restores the round-5
+    # einsum-and-GSPMD behavior for comparison.
     moe_dispatch: str = "a2a"
 
 
@@ -209,7 +213,7 @@ def build_parser(
         parser.add_argument("--num_experts", type=int, default=8)
         parser.add_argument("--moe_top_k", type=int, default=1)
         parser.add_argument(
-            "--moe_dispatch", choices=("a2a", "xla"), default="a2a"
+            "--moe_dispatch", choices=("a2a", "xla", "pallas"), default="a2a"
         )
     parser.add_argument("--seed", type=int, default=defaults.seed)
     parser.add_argument("--dropout", type=float, default=defaults.dropout)

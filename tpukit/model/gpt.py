@@ -141,10 +141,21 @@ class GPTConfig:
     # single-chip bench path are untouched. "a2a": explicit shard_map
     # dispatch — tokens pack into per-expert capacity buffers and move
     # through a hand-placed lax.all_to_all pair over `moe_mesh`'s `expert`
-    # axis in BOTH forward and backward. ExpertParallel injects "a2a" (and
-    # the mesh) at loss time; plain model calls never see it.
-    moe_dispatch: str = "xla"  # "xla" | "a2a"
-    moe_mesh: Any = None  # jax Mesh with an 'expert' axis (a2a dispatch only)
+    # axis in BOTH forward and backward. "pallas" (tpukit/ops/moe_gemm.py,
+    # round 11): the fused grouped-expert GEMM — sort tokens by expert and
+    # run a blocked segment GEMM, no capacity buffer, dropless unless
+    # moe_capacity is set; under ExpertParallel it composes after the a2a
+    # exchange. ExpertParallel injects its dispatch (and the mesh) at loss
+    # time; plain model calls see only what the caller configured.
+    moe_dispatch: str = "xla"  # "xla" | "a2a" | "pallas"
+    moe_mesh: Any = None  # jax Mesh with an 'expert' axis (a2a/pallas under EP)
+    # Explicit per-row expert capacity. 0 (default) keeps the derived
+    # capacity (ceil(max_position * top_k * capacity_factor / E)) for the
+    # buffer dispatches and makes the "pallas" dispatch DROPLESS; > 0
+    # overrides the derived value on every dispatch — the same cumsum drop
+    # mask everywhere, so "pallas" capacity mode drops the bit-identical
+    # token set the buffer paths drop (tests/test_moe.py).
+    moe_capacity: int = 0
 
     def __post_init__(self):
         if self.num_experts > 0 and not (1 <= self.router_top_k <= self.num_experts):
@@ -153,9 +164,10 @@ class GPTConfig:
                 f"num_experts={self.num_experts}] — silently clamping would "
                 f"train a different routing than the one requested"
             )
-        if self.moe_dispatch not in ("xla", "a2a"):
+        if self.moe_dispatch not in ("xla", "a2a", "pallas"):
             raise ValueError(
-                f"moe_dispatch={self.moe_dispatch!r} must be 'xla' or 'a2a'"
+                f"moe_dispatch={self.moe_dispatch!r} must be 'xla', 'a2a' "
+                f"or 'pallas'"
             )
 
     @property
@@ -316,16 +328,25 @@ def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic, pad_mask=None):
     full-reforward path there — use_cache=False is exact.
 
     The dispatch DATAFLOW is pluggable (cfg.moe_dispatch, implementations
-    in tpukit/ops/moe_dispatch.py): "xla" computes global one-hot
-    dispatch/combine einsums and leaves partitioning to GSPMD; "a2a" (what
-    ExpertParallel injects) hand-places the token exchange as a
-    lax.all_to_all pair over the `expert` mesh axis inside shard_map —
-    identical math, and the backward is also an all_to_all pair instead of
-    the GSPMD replicate-repartition fallback the einsum transpose provokes
-    (MULTICHIP_r05.json). Dropout applies to the combined output, outside
-    either dataflow, so the two stay loss/grad-parity-equal.
+    in tpukit/ops/moe_dispatch.py and tpukit/ops/moe_gemm.py): "xla"
+    computes global one-hot dispatch/combine einsums and leaves
+    partitioning to GSPMD; "a2a" (the ExpertParallel default) hand-places
+    the token exchange as a lax.all_to_all pair over the `expert` mesh
+    axis inside shard_map — identical math, and the backward is also an
+    all_to_all pair instead of the GSPMD replicate-repartition fallback
+    the einsum transpose provokes (MULTICHIP_r05.json); "pallas" sorts
+    tokens by expert and runs the fused Pallas segment GEMM — no capacity
+    buffer or padding FLOPs, dropless unless cfg.moe_capacity is set, and
+    under ExpertParallel it rides the same a2a exchange. Dropout applies
+    to the combined output, outside every dataflow, so all three stay
+    loss/grad-parity-equal.
     """
-    impl = moe_ffn_a2a if cfg.moe_dispatch == "a2a" else moe_ffn_xla
+    if cfg.moe_dispatch == "pallas":
+        from tpukit.ops.moe_gemm import moe_ffn_pallas
+
+        impl = moe_ffn_pallas
+    else:
+        impl = moe_ffn_a2a if cfg.moe_dispatch == "a2a" else moe_ffn_xla
     out, aux = impl(layer, cfg, x, pad_mask=pad_mask)
     return dropout(out, cfg.dropout, rng, deterministic), aux
 

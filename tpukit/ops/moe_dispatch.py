@@ -1,9 +1,9 @@
 """Mixture-of-experts expert dispatch/combine implementations.
 
-Two interchangeable dataflows sit behind `GPTConfig.moe_dispatch`; both
+Three interchangeable dataflows sit behind `GPTConfig.moe_dispatch`; all
 compute the SAME math (routing, per-row capacity, expert FFN, gated
 combine, load-balance aux) so they are loss/grad-parity-equal and the
-parity goldens in tests/test_moe.py hold across either:
+parity goldens in tests/test_moe.py hold across any of them:
 
   - "xla" (default): the original global one-hot einsum formulation.
     Dispatch is `[B,S,E,C] x [B,S,D] -> [E,B,C,D]`, combine is the
@@ -30,6 +30,17 @@ parity goldens in tests/test_moe.py hold across either:
     layer, never a GSPMD replicate-repartition (asserted against the
     optimized HLO in tests/test_moe.py and the multichip dryrun).
 
+  - "pallas" (tpukit/ops/moe_gemm.py, round 11): the fused grouped-expert
+    GEMM. Meshless it sorts token rows by assigned expert and runs a
+    blocked segment GEMM — no `[E, B, C, D]` capacity buffer, no padding
+    FLOPs, dropless unless `cfg.moe_capacity` is explicitly set. Under
+    ExpertParallel it composes AFTER the a2a exchange: the same shard_map
+    block as "a2a" (same collectives, same byte audit) with the local
+    expert FFN routed through the kernel. The exchange block is shared
+    code (`_moe_ffn_exchange`, parametrized over the local expert-FFN
+    implementation), so the collective schedule — and the closed-form
+    byte audit against it — cannot drift between the two.
+
 Collectives are hand-scheduled rather than compiler-inferred — the core
 lesson of the collectives literature (PAPERS.md: "The Big Send-off",
 GC3). `expected_a2a` is the audit half: the closed-form per-device
@@ -52,7 +63,15 @@ def moe_capacity(cfg, seq_len: int) -> int:
     surrounds it) scaled by the routed-experts count (top-k generates k*S
     assignments per row — the GShard convention), then clamped to the call
     width: a row position can never reach seq_len, so the clamp is
-    output-identical while keeping short decode buffers cheap."""
+    output-identical while keeping short decode buffers cheap.
+
+    `cfg.moe_capacity > 0` overrides the factor-derived value (still
+    clamped to the call width) for EVERY dispatch impl, so an explicit
+    capacity produces the same drop set on "xla", "a2a" and the capacity
+    mode of "pallas" — the bit-identical drop-parity contract
+    tests/test_moe.py asserts."""
+    if cfg.moe_capacity > 0:
+        return min(cfg.moe_capacity, seq_len)
     top_k = cfg.router_top_k
     capacity = max(
         1,
@@ -64,10 +83,53 @@ def moe_capacity(cfg, seq_len: int) -> int:
     return min(capacity, seq_len)
 
 
+def _route_topk(x, router_kernel, cfg):
+    """Shared routing front half: f32 router softmax and the top-k choice.
+    Row-local math — identical whether `x` is the global batch (xla/pallas
+    paths) or one device's shard (a2a path). This is the ONE place the
+    discrete choice is computed, so every dispatch impl routes each token
+    to bit-identical experts.
+
+    Returns (xc, top_idx, top_vals, probs, assign):
+      xc       [B,S,D]  x in the compute dtype
+      top_idx  [B,S,K]  int32 chosen expert ids
+      top_vals [B,S,K]  f32 raw router probability of each chosen expert
+      probs    [B,S,E]  f32 full softmax (aux statistics)
+      assign   [B,S,E]  f32 0/1 chosen-expert mask (aux statistics + drops)
+    """
+    xc = x.astype(cfg.compute_dtype)
+    # router math is f32 (softmax stability under bf16 compute)
+    logits = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32), router_kernel.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [B, S, E] f32
+    top_vals, top_idx = jax.lax.top_k(probs, cfg.router_top_k)  # [B, S, K]
+    # per-(token, expert) assignment; the k chosen experts are distinct,
+    # so the one-hot sum stays 0/1-valued
+    choice_oh = jax.nn.one_hot(top_idx, cfg.num_experts, dtype=jnp.float32)
+    assign = jnp.sum(choice_oh, axis=2)  # [B, S, E]
+    return xc, top_idx, top_vals, probs, assign
+
+
+def _slot_positions(assign):
+    """[B,S,E] position of each token in its expert's per-row buffer
+    (cumsum along the sequence is causal: later tokens never evict earlier
+    ones); -1 where unassigned. The single spelling of the buffer-position
+    rule — both the kept mask and the slot one-hot derive from it."""
+    return jnp.cumsum(assign, axis=1) * assign - 1.0
+
+
+def _kept_mask(assign, capacity: int):
+    """[B,S,E] 0/1 mask of assignments that SURVIVE the per-row capacity
+    (position >= capacity drops). The single spelling of the drop rule —
+    the pallas path's capacity mode reuses it verbatim, which is what
+    makes its drop set bit-identical to the xla/a2a buffers'."""
+    return assign * (_slot_positions(assign) < capacity)
+
+
 def _route(x, router_kernel, cfg):
-    """Shared routing front half: top-k choice, gates, and the per-row
-    fixed-capacity dispatch one-hot. Row-local math — identical whether `x`
-    is the global batch (xla path) or one device's shard (a2a path).
+    """Routing + the per-row fixed-capacity dispatch one-hot (the buffer
+    formulations: "xla" and the a2a exchange).
 
     Returns (xc, dispatch, gate_map, probs, assign):
       xc       [B,S,D]  x in the compute dtype
@@ -76,29 +138,13 @@ def _route(x, router_kernel, cfg):
       probs    [B,S,E]  f32 full softmax (aux statistics)
       assign   [B,S,E]  f32 0/1 chosen-expert mask (aux statistics)
     """
-    n_exp = cfg.num_experts
-    top_k = cfg.router_top_k
     capacity = moe_capacity(cfg, x.shape[1])
-
-    xc = x.astype(cfg.compute_dtype)
-    # router math is f32 (softmax stability under bf16 compute)
-    logits = jnp.einsum(
-        "bsd,de->bse", x.astype(jnp.float32), router_kernel.astype(jnp.float32)
-    )
-    probs = jax.nn.softmax(logits, axis=-1)  # [B, S, E] f32
-    top_vals, top_idx = jax.lax.top_k(probs, top_k)  # [B, S, K]
-    # per-(token, expert) assignment and raw-probability gates; the k
-    # chosen experts are distinct, so the one-hot sum stays 0/1-valued
-    choice_oh = jax.nn.one_hot(top_idx, n_exp, dtype=jnp.float32)  # [B, S, K, E]
-    assign = jnp.sum(choice_oh, axis=2)  # [B, S, E]
+    xc, top_idx, top_vals, probs, assign = _route_topk(x, router_kernel, cfg)
+    choice_oh = jax.nn.one_hot(top_idx, cfg.num_experts, dtype=jnp.float32)
     gate_map = jnp.sum(top_vals[..., None] * choice_oh, axis=2)  # [B, S, E]
 
-    # position of each token in its expert's per-row buffer (cumsum along
-    # the sequence is causal: later tokens never evict earlier ones);
-    # >= capacity drops
-    pos = jnp.cumsum(assign, axis=1) * assign - 1.0
-    kept = assign * (pos < capacity)
-    slot = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    kept = _kept_mask(assign, capacity)
+    slot = jnp.clip(_slot_positions(assign), 0, capacity - 1).astype(jnp.int32)
     dispatch = (
         kept[..., None] * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
     ).astype(cfg.compute_dtype)  # [B, S, E, C]
@@ -184,23 +230,34 @@ def moe_ffn_a2a(layer, cfg, x, pad_mask=None):
     axes, so the scalar matches the global formula. Degenerate axes
     (expert mesh size 1) skip the collective but keep the same block, so
     single-group meshes still share one code path."""
+    return _moe_ffn_exchange(layer, cfg, x, pad_mask, _expert_ffn, "a2a")
+
+
+def _moe_ffn_exchange(layer, cfg, x, pad_mask, expert_ffn, name):
+    """The shared ExpertParallel exchange block (docstring at moe_ffn_a2a).
+    `expert_ffn(experts_l, expert_in, dtype)` computes the local expert
+    shard's FFN on the post-exchange `[E_local, ep*B_local, C, D]` buffer:
+    the batched einsums for "a2a", the grouped segment GEMM of
+    tpukit/ops/moe_gemm.py for "pallas". Everything around it — pack,
+    collectives, combine, aux — is ONE copy of code, so the byte audit
+    (`expected_a2a`) holds for both by construction."""
     mesh = cfg.moe_mesh
     if mesh is None:
         raise ValueError(
-            "moe_dispatch='a2a' needs cfg.moe_mesh (a mesh with an 'expert' "
-            "axis) — ExpertParallel injects it; set moe_dispatch='xla' for "
-            "meshless execution"
+            f"moe_dispatch={name!r} under ExpertParallel needs cfg.moe_mesh "
+            f"(a mesh with an 'expert' axis) — ExpertParallel injects it; "
+            f"set moe_dispatch='xla' for meshless buffer execution"
         )
     if "expert" not in mesh.axis_names:
         raise ValueError(
-            f"moe_dispatch='a2a' needs an 'expert' axis in cfg.moe_mesh, "
+            f"moe_dispatch={name!r} needs an 'expert' axis in cfg.moe_mesh, "
             f"got axes {mesh.axis_names}"
         )
     ep = mesh.shape["expert"]
     if cfg.num_experts % ep:
         raise ValueError(
             f"num_experts {cfg.num_experts} must divide over the {ep}-way "
-            f"expert mesh axis for a2a dispatch"
+            f"expert mesh axis for {name} dispatch"
         )
     # rows shard over every available mesh axis — ExpertParallel.batch_spec
     row_axes = tuple(a for a in ("data", "expert") if a in mesh.axis_names)
@@ -219,7 +276,7 @@ def moe_ffn_a2a(layer, cfg, x, pad_mask=None):
             expert_in = jax.lax.all_to_all(
                 expert_in, "expert", split_axis=0, concat_axis=1, tiled=True
             )
-        h = _expert_ffn(experts_l, expert_in, cfg.compute_dtype)
+        h = expert_ffn(experts_l, expert_in, cfg.compute_dtype)
         if ep > 1:
             # mirrored return trip -> [E, B_local, C, D] back on the source
             h = jax.lax.all_to_all(

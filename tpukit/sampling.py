@@ -20,6 +20,10 @@ Unlike the reference (which re-runs the full forward per token,
 utils.py:63-64), decoding defaults to a KV-cached path: prefill the prompt
 once, then one-token steps against per-layer K/V buffers. The naive loop is
 kept (`use_cache=False`) and the two are equivalence-tested token-for-token.
+Both loops support temperature/top-k sampling (round 11 — the cached loop
+previously raised on temperature>0, VERDICT r5 #5): the per-position key
+fold is identical in the two loops, so a fixed seed samples the same tokens
+cached and uncached.
 """
 
 from __future__ import annotations
@@ -80,13 +84,27 @@ def _decode_loop(
     return buf, cur
 
 
-@partial(jax.jit, static_argnames=("cfg", "prompt_len", "max_new_tokens", "eos_id"))
-def _decode_loop_cached(params, cfg: gpt.GPTConfig, buf, prompt_len: int, max_new_tokens: int, eos_id: int):
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "prompt_len", "max_new_tokens", "eos_id", "temperature", "top_k"),
+)
+def _decode_loop_cached(
+    params, cfg: gpt.GPTConfig, buf, prompt_len: int, max_new_tokens: int,
+    eos_id: int, temperature: float = 0.0, top_k: int = 0, rng=None,
+):
     """KV-cached twin of `_decode_loop`: the prompt is prefilled once, then
     each step forwards ONE token against the cache — O(S) attention per
     token instead of the naive loop's O(S^2) full re-forward (the
     reference's known wart, utils.py:63-64). Token-for-token equivalent to
-    the naive loop (tests/test_sampling.py)."""
+    the naive loop (tests/test_sampling.py).
+
+    temperature/top_k mirror `_decode_loop` exactly (round 11, the first
+    rung of the serving ladder — VERDICT r5 #5 flagged the cached path
+    raising on temperature>0): the SAME per-position key fold
+    (`fold_in(rng, cur)`) and the same truncate-then-categorical math, so
+    a fixed seed samples the same tokens cached and uncached — the
+    same-seed equivalence tests/test_sampling.py asserts. The static
+    temperature==0 branch keeps the greedy decode trace byte-unchanged."""
     total = buf.shape[1]
     cache = gpt.init_kv_cache(cfg, 1, total)
     if prompt_len > 1:
@@ -103,7 +121,17 @@ def _decode_loop_cached(params, cfg: gpt.GPTConfig, buf, prompt_len: int, max_ne
         tok = jax.lax.dynamic_slice(buf, (0, cur - 1), (1, 1))
         pos = jnp.reshape(cur - 1, (1, 1)).astype(jnp.int32)
         logits, cache = gpt.forward_cached(params, cfg, tok, pos, cache, cur - 1)
-        next_token = jnp.argmax(logits[0, -1].astype(jnp.float32), axis=-1).astype(buf.dtype)
+        last = logits[0, -1].astype(jnp.float32)
+        if temperature > 0.0:  # static branch: greedy decode trace unchanged
+            scaled = last / temperature
+            if top_k > 0:
+                kth = jax.lax.top_k(scaled, top_k)[0][-1]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            next_token = jax.random.categorical(
+                jax.random.fold_in(rng, cur), scaled
+            ).astype(buf.dtype)
+        else:
+            next_token = jnp.argmax(last, axis=-1).astype(buf.dtype)
         done = next_token == eos_id
         new_buf = jnp.where(done, buf, buf.at[0, cur].set(next_token))
         new_cur = jnp.where(done, cur, cur + 1)
@@ -216,7 +244,6 @@ def generate(
     buf[0, :prompt_len] = ids
 
     eos = tokenizer.eos_token_id
-    explicit_cache = use_cache  # caller's stated choice, before auto-resolve
     if use_cache is None:
         # Measured on v5e: the cached path wins on long buffers (O(S) vs
         # O(S^2) per token) but its per-step cache updates cost more than
@@ -225,26 +252,18 @@ def generate(
         # chunk with its own expert-capacity window, which can diverge
         # from full-sequence routing (gpt._apply_moe_ffn docstring).
         use_cache = buf.shape[1] >= 512 and cfg.num_experts == 0
-    if temperature > 0.0:
-        # sampling runs the naive full-reforward loop only (the cached loop
-        # is greedy-only) — fail loudly on an EXPLICITLY requested cached
-        # path instead of silently dropping the caller's choice (ADVICE
-        # r5 #4; the repo's fail-loud convention). An auto-resolved
-        # use_cache (the caller passed None) downgrades silently as before:
-        # the caller stated no preference to violate.
-        if explicit_cache:
-            raise ValueError(
-                f"use_cache=True is greedy-only: the KV-cached decode loop "
-                f"does not implement sampling (temperature={temperature}). "
-                f"Drop use_cache (or pass use_cache=False) to sample via "
-                f"the exact full-reforward loop, or set temperature=0 for "
-                f"cached greedy decoding."
-            )
-        use_cache = False
     if use_cache:
+        # Round 11 (first rung of the serving ladder, ROADMAP #1): the
+        # cached loop samples too — same key fold, same truncation math as
+        # the naive loop, so a fixed seed decodes the same tokens either
+        # way (the r5 #5 raise is gone; same-seed equivalence is tested).
         buf, length = _decode_loop_cached(
             params, cfg, _replicate_like(params, buf), prompt_len,
-            max_new_tokens, int(eos),
+            max_new_tokens, int(eos), temperature=float(temperature),
+            top_k=min(int(top_k), cfg.padded_vocab_size),
+            rng=_replicate_like(params, np.asarray(jax.random.PRNGKey(seed)))
+            if temperature > 0.0
+            else None,
         )
     else:
         buf, length = _decode_loop(

@@ -646,6 +646,14 @@ class ExpertParallel(Strategy):
         token all_to_all GPU MoE frameworks hand-write with NCCL, actually
         placed by hand.
 
+      - "pallas": the "a2a" exchange with the local expert FFN computed by
+        the fused grouped-expert GEMM of tpukit/ops/moe_gemm.py instead of
+        the batched capacity einsums — the collectives (and the byte
+        audit) are byte-for-byte the a2a path's; only the on-device FFN
+        spelling changes. Meshless callers of moe_dispatch="pallas" get
+        the dropless sorted dataflow instead; under EP the exchange's
+        static per-peer payloads make capacity buffers structural.
+
       - "xla": the round-5 behavior — global dispatch/combine einsums with
         partitioning left to GSPMD. The FORWARD partitions into
         all_to_all-shaped collectives, but the BACKWARD of the dispatch
@@ -672,9 +680,9 @@ class ExpertParallel(Strategy):
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh({"expert": -1})
         if "expert" not in self.mesh.axis_names:
             raise ValueError("ExpertParallel needs an 'expert' mesh axis")
-        if dispatch not in ("xla", "a2a"):
+        if dispatch not in ("xla", "a2a", "pallas"):
             raise ValueError(
-                f"dispatch must be 'xla' or 'a2a', got {dispatch!r}"
+                f"dispatch must be 'xla', 'a2a' or 'pallas', got {dispatch!r}"
             )
         self.dispatch = dispatch
         self.min_shard_size = min_shard_size
@@ -749,12 +757,13 @@ class ExpertParallel(Strategy):
         return tree.replace(params=params) if is_state else params
 
     def _dispatch_cfg(self, cfg: gpt.GPTConfig) -> gpt.GPTConfig:
-        """Config the loss actually runs with: the a2a dispatch impl + this
-        mesh injected for MoE configs. Loss-time only — checkpoints, decode
-        and the plain model surface never carry a mesh in their config."""
-        if cfg.num_experts <= 0 or self.dispatch != "a2a":
+        """Config the loss actually runs with: the a2a/pallas dispatch impl
+        + this mesh injected for MoE configs. Loss-time only — checkpoints,
+        decode and the plain model surface never carry a mesh in their
+        config."""
+        if cfg.num_experts <= 0 or self.dispatch == "xla":
             return cfg
-        return cfg.replace(moe_dispatch="a2a", moe_mesh=self.mesh)
+        return cfg.replace(moe_dispatch=self.dispatch, moe_mesh=self.mesh)
 
     def loss_fn(
         self, params, cfg: gpt.GPTConfig, batch, targets,
@@ -768,11 +777,13 @@ class ExpertParallel(Strategy):
     def dispatch_comm(self, cfg: gpt.GPTConfig, global_batch: int,
                       seq: int) -> dict | None:
         """Expected per-device all-to-all payload for one step of the a2a
-        dispatch (tpukit/ops/moe_dispatch.expected_a2a) — the audit number
-        fit()'s xla record and bench.py's moe_ep_comm probe compare against
-        the compiled HLO. None for the xla dispatch (GSPMD's choices are
-        measured, not predicted) and for dense configs."""
-        if self.dispatch != "a2a" or cfg.num_experts <= 0:
+        or pallas dispatch (tpukit/ops/moe_dispatch.expected_a2a — the
+        pallas dispatch rides the identical exchange, so the same closed
+        form audits both) — the audit number fit()'s xla record and
+        bench.py's moe_ep_comm probe compare against the compiled HLO.
+        None for the xla dispatch (GSPMD's choices are measured, not
+        predicted) and for dense configs."""
+        if self.dispatch == "xla" or cfg.num_experts <= 0:
             return None
         from tpukit.ops.moe_dispatch import expected_a2a
 

@@ -22,7 +22,11 @@ all-to-all bytes, involuntary-remat warning count, a2a-path throughput.
 The `moe_dispatch_ladder` record (round 11, ROADMAP #3) measures the
 three MoE dataflows — xla buffers, a2a exchange, pallas grouped GEMM — at
 e8 top-1/top-2 with active-FLOPs-normalized MFU; `--moe_dispatch pallas`
-flips the headline moe_e8 probe onto the kernel path.
+flips the headline moe_e8 probe onto the kernel path. The `quant_comm`
+record (round 12, ROADMAP #2) measures `--comm_dtype` f32 vs bf16 vs int8
+per strategy rung (ddp/fsdp/ep): expected+measured bytes-on-the-wire (the
+~4x int8 cut is the headline), tokens/s/chip, and the final-loss delta vs
+f32 — the tolerance-gate number.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -247,21 +251,23 @@ def bench_moe_ep_comm(cfg, n_dev, num_experts=8, steps=8):
     measured = collective_bytes(compiled.as_text()).get(
         "all-to-all", {"count": 0, "bytes": 0}
     )
-    expected = strat.dispatch_comm(cfg_m, global_batch=batch, seq=seq - 1)["train"]
+    backend = jax.default_backend()
+    # dtype-aware expectation (round 12): the closed form prices in the
+    # backend's wire dtype (XLA:CPU upcasts bf16 payloads to f32), so the
+    # byte comparison is EXACT on every backend — the old cpu 2x allowance
+    # is gone, a drift is a drift.
+    expected = strat.dispatch_comm(
+        cfg_m, global_batch=batch, seq=seq - 1, backend=backend
+    )["train"]
     # time the COMPILED executable: on jax 0.4.x the AOT path does not
     # populate the jit call cache, so timing `step` would recompile
     times, state, loss = time_windows(
         compiled, state, b, t, steps=steps, windows=3, warmup=2
     )
     del state
-    # XLA:CPU upcasts the bf16 compute to f32, exactly doubling the a2a
-    # payload while op counts stay put — the same allowance the fit-record
-    # renderer applies; on accelerators only the exact byte count passes.
-    backend = jax.default_backend()
-    bytes_match = measured["bytes"] == expected["bytes"] or (
-        backend == "cpu"
-        and measured["count"] == expected["count"]
-        and measured["bytes"] == 2 * expected["bytes"]
+    bytes_match = (
+        measured["count"] == expected["count"]
+        and measured["bytes"] == expected["bytes"]
     )
     return {
         "mesh": grid,
@@ -341,6 +347,152 @@ def bench_moe_dispatch_ladder(cfg, n_dev, num_experts=8, steps=8):
                 )
                 print(
                     f"moe ladder rung {dispatch}/top{top_k} failed: {exc!r}",
+                    file=sys.stderr,
+                )
+    return rows
+
+
+def bench_quant_comm(cfg, n_dev, num_experts=8, steps=8):
+    """Quantized-collective ladder (round 12, ROADMAP #2): f32 vs bf16 vs
+    int8 `--comm_dtype` on each strategy with hand-wired quantized
+    collectives — ddp (grad all-reduce), fsdp (grad reduce-scatter), ep
+    (a2a dispatch payload). Each rung compiles the train step under a
+    compiler-stderr capture and reports:
+
+      - expected vs measured quantized payload bytes (the closed-form
+        `grad_comm`/`dispatch_comm` numbers against the optimized HLO) and
+        whether they match exactly;
+      - ring-model bytes-on-the-wire (`obs.wire_bytes` — result payloads
+        are not comparable across op KINDS, an all-reduce moves ~2x its
+        result) plus the ratio vs the rung's f32 baseline: the ~4x cut is
+        THE headline this record exists to publish;
+      - involuntary-remat warning count (zero = the schedule did not
+        change, only the payload — meaningful on cold compiles);
+      - tokens/s/chip and the final-loss delta vs the f32 rung after
+        `steps` identical steps — the tolerance-gate number (bit parity is
+        impossible by construction; a small bounded delta is the
+        correctness contract).
+
+    On one chip the data/expert axes are 1-way: the wrappers keep the
+    quantize/dequantize numerics but skip the collectives, so expected
+    bytes are honestly zero rather than faked."""
+    import math
+
+    import jax
+
+    from tools.bench_ladder import make_batch, setup_step, time_windows
+    from tpukit.mesh import create_mesh
+    from tpukit.obs import (
+        capture_compiler_stderr,
+        collective_bytes,
+        count_involuntary_remat,
+        wire_bytes,
+    )
+    from tpukit.shardings import DataParallel, ExpertParallel, FSDP
+
+    seq = cfg.max_position_embeddings
+    batch = 32 * n_dev
+    expert = math.gcd(n_dev, num_experts)
+    backend = jax.default_backend()
+    struct = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+
+    rungs = [
+        ("ddp", lambda: DataParallel(create_mesh({"data": n_dev})),
+         lambda dt: cfg.replace(comm_dtype=dt), n_dev),
+        ("fsdp", lambda: FSDP(create_mesh({"data": n_dev})),
+         lambda dt: cfg.replace(comm_dtype=dt), n_dev),
+        ("ep", lambda: ExpertParallel(
+            create_mesh({"data": n_dev // expert, "expert": expert}),
+            dispatch="a2a"),
+         lambda dt: cfg.replace(comm_dtype=dt, num_experts=num_experts),
+         expert),
+    ]
+    rows = []
+    for name, strat_fn, cfg_fn, world in rungs:
+        f32_loss = f32_wire = None
+        for dtype in ("f32", "bf16", "int8"):
+            try:
+                c = cfg_fn(dtype)
+                strat = strat_fn()
+                strat.validate_config(c)
+                b, t = make_batch(
+                    np.random.RandomState(5), cfg.vocab_size, batch, seq - 1
+                )
+                with capture_compiler_stderr() as cap:
+                    step, state, shapes, _ = setup_step(c, strat)
+                    compiled = step.lower(
+                        shapes, jax.tree.map(struct, b), struct(t)
+                    ).compile()
+                coll = collective_bytes(compiled.as_text())
+                if name == "ep":
+                    # the EP rung's wire number AND its expectation isolate
+                    # the a2a dispatch payload: the trunk's FSDP comm is
+                    # identical across rungs (full precision by design) and
+                    # would bury the dispatch cut in a shared constant
+                    wire = wire_bytes(
+                        {"all-to-all": coll.get("all-to-all")
+                         or {"count": 0, "bytes": 0}},
+                        world,
+                    )
+                    audit = strat.dispatch_comm(
+                        c, global_batch=batch, seq=seq - 1, backend=backend
+                    )
+                    expected = (
+                        {"all-to-all": {
+                            "count": audit["train"]["count"],
+                            "bytes": audit["train"]["bytes"],
+                        }}
+                        if audit
+                        else None
+                    )
+                else:
+                    wire = wire_bytes(coll, world)
+                    expected = strat.grad_comm(c, shapes.params, backend=backend)
+                exact = None
+                if expected:
+                    exact = all(
+                        (coll.get(op) or {"count": 0, "bytes": 0}) == rec
+                        for op, rec in expected.items()
+                    )
+                times, state, loss = time_windows(
+                    compiled, state, b, t, steps=steps, windows=3, warmup=2
+                )
+                del state
+                row = {
+                    "strategy": name,
+                    "comm_dtype": dtype,
+                    "wire_bytes": wire,
+                    "expected": expected,
+                    "measured": {
+                        op: coll.get(op)
+                        for op in (expected or {})
+                        if coll.get(op)
+                    } or None,
+                    "bytes_match": exact,
+                    "involuntary_remat_warnings": count_involuntary_remat(
+                        cap["text"]
+                    ),
+                    "tokens_per_sec_per_chip": round(
+                        steps * batch * (seq - 1) / min(times) / n_dev, 1
+                    ),
+                    "final_loss": round(loss, 6),
+                }
+                if dtype == "f32":
+                    f32_loss, f32_wire = loss, wire
+                else:
+                    row["loss_delta_vs_f32"] = (
+                        round(loss - f32_loss, 6) if f32_loss is not None else None
+                    )
+                    row["wire_ratio_vs_f32"] = (
+                        round(wire / f32_wire, 4) if f32_wire else None
+                    )
+                rows.append(row)
+            except Exception as exc:
+                rows.append(
+                    {"strategy": name, "comm_dtype": dtype, "error": repr(exc)}
+                )
+                print(
+                    f"quant comm rung {name}/{dtype} failed: {exc!r}",
                     file=sys.stderr,
                 )
     return rows
@@ -517,6 +669,17 @@ def main(argv=None):
         moe_dispatch_ladder = [{"dispatch": "ladder", "error": repr(exc)}]
         print(f"moe dispatch ladder failed: {exc!r}", file=sys.stderr)
 
+    # Quantized collectives (round 12, ROADMAP #2): f32 vs bf16 vs int8
+    # --comm_dtype per strategy rung — expected+measured bytes on the wire,
+    # tokens/s/chip, final-loss delta vs f32. Per-rung errors land inside
+    # the record itself.
+    quant_comm_rec = None
+    try:
+        quant_comm_rec = bench_quant_comm(cfg, n_dev)
+    except Exception as exc:
+        quant_comm_rec = [{"strategy": "quant_comm", "error": repr(exc)}]
+        print(f"quant comm ladder failed: {exc!r}", file=sys.stderr)
+
     # Host input pipeline (round 7): sync data+h2d share vs the depth-2
     # prefetcher's residual stall share, with loss-parity proof.
     host_pipeline, host_pipeline_err = None, None
@@ -570,6 +733,7 @@ def main(argv=None):
         "moe_ep_comm": moe_ep_comm,
         "moe_ep_comm_error": moe_ep_comm_err,
         "moe_dispatch_ladder": moe_dispatch_ladder,
+        "quant_comm": quant_comm_rec,
         "host_pipeline": host_pipeline,
         "host_pipeline_error": host_pipeline_err,
         "obs_overhead": obs_overhead,

@@ -22,7 +22,11 @@ all-to-all dispatch audit line to the "xla" section (the strategy's
 closed-form payload vs the compiled HLO's) and renders bench.py's
 `moe_ep_comm` record when pointed at a bench JSON; round 11 renders the
 `moe_dispatch_ladder` record (xla vs a2a vs pallas at e8 top-1/top-2,
-active-FLOPs-normalized MFU — ROADMAP #3). This tool needs NOTHING but
+active-FLOPs-normalized MFU — ROADMAP #3). Round 12 adds the quantized
+grad-collective audit line to the "xla" section (--comm_dtype: the
+closed-form compressed payload vs the compiled HLO, dtype-aware so it is
+exact on CPU too) and renders bench.py's `quant_comm` record with the
+bytes-on-the-wire headline. This tool needs NOTHING but
 the file — no jax import, so it runs anywhere the log was copied to.
 
 Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
@@ -182,29 +186,47 @@ def summarize(records: list[dict]) -> str:
         elif expected:
             w(f"  comm: none found (strategy expected {sorted(expected)})")
         # round-10 hand-scheduled dispatch audit: the strategy's closed-form
-        # all-to-all payload vs what the compiled HLO actually moves. Eval
-        # steps on CPU backends upcast bf16 to f32 (2x bytes) — counts are
-        # the hard signal there.
+        # all-to-all payload vs what the compiled HLO actually moves.
+        # Round-12 expectations carry a "wire" marker: the formula already
+        # priced in the backend's wire dtype (XLA:CPU upcasts bf16 payloads
+        # to f32), so bytes compare EXACTLY — no soft excuse. Older logs
+        # without the marker keep the CPU bf16-upcast allowance.
         a2a_exp = r.get("a2a_expected")
         if a2a_exp is not None:
             meas = coll.get("all-to-all") or {"count": 0, "bytes": 0}
             count_ok = meas["count"] == a2a_exp.get("count")
             bytes_ok = meas["bytes"] == a2a_exp.get("bytes")
+            dtype_aware = a2a_exp.get("wire") is not None
             if count_ok and bytes_ok:
                 verdict = "  OK"
-            elif count_ok and r.get("backend") == "cpu":
-                # XLA:CPU upcasts bf16 compute to f32, doubling a2a bytes
-                # while op counts still match — only the CPU backend gets
-                # this excuse; a byte drift on an accelerator with the
-                # counts intact is exactly the payload-regression class
-                # this audit exists to flag
+            elif count_ok and not dtype_aware and r.get("backend") == "cpu":
+                # pre-round-12 record: the expectation was the nominal
+                # accelerator size, so CPU's bf16->f32 upcast doubled the
+                # measured bytes legitimately
                 verdict = "  counts OK (bytes differ: CPU bf16-upcast)"
             else:
                 verdict = "  <- MISMATCH"
             w(f"  all-to-all dispatch audit: measured x{meas['count']} "
               f"{human_bytes(meas['bytes'])} vs expected "
               f"x{a2a_exp.get('count')} {human_bytes(a2a_exp.get('bytes'))}"
+              + (f" [{a2a_exp['wire']}]" if dtype_aware else "")
               + verdict)
+        # round-12 quantized grad-collective audit (--comm_dtype): the
+        # closed-form compressed grad payload (ddp two-shot all-reduce /
+        # fsdp reduce-scatter a2a) vs the compiled HLO, op kind by op kind.
+        # Always dtype-aware, so a byte drift is a hard flag everywhere.
+        gexp = r.get("quant_grad_expected")
+        if gexp is not None:
+            w(f"  quantized grad audit (--comm_dtype "
+              f"{r.get('comm_dtype', '?')}):")
+            for op, rec in sorted(gexp.items()):
+                meas = coll.get(op) or {"count": 0, "bytes": 0}
+                ok = (meas["count"] == rec["count"]
+                      and meas["bytes"] == rec["bytes"])
+                w(f"    {op:<12} measured x{meas['count']} "
+                  f"{human_bytes(meas['bytes'])} vs expected x{rec['count']} "
+                  f"{human_bytes(rec['bytes'])}"
+                  + ("  OK" if ok else "  <- MISMATCH"))
 
     val = _rows(records, "validation")
     epochs = _rows(records, "epoch")
@@ -340,6 +362,38 @@ def summarize(records: list[dict]) -> str:
         if warns is not None:
             w(f"  involuntary-remat warnings at compile: {warns}"
               + ("" if warns == 0 else "  <- GSPMD replicate-repartition!"))
+    # round-12 quantized collectives (ROADMAP #2): f32 vs bf16 vs int8
+    # --comm_dtype per strategy rung, with the bytes-on-the-wire cut as
+    # the headline and the loss delta as the tolerance-gate number.
+    for r in records:
+        qc = r.get("quant_comm")
+        if not isinstance(qc, list) or not qc:
+            continue
+        w("== quantized collectives (bench, --comm_dtype) ==")
+        int8_ratios = []
+        for row in qc:
+            if "error" in row:
+                w(f"  {row.get('strategy', '?'):<5} "
+                  f"{row.get('comm_dtype', '?'):<5} ERROR {row['error']}")
+                continue
+            ratio = row.get("wire_ratio_vs_f32")
+            delta = row.get("loss_delta_vs_f32")
+            match = row.get("bytes_match")
+            warns = row.get("involuntary_remat_warnings")
+            w(f"  {row['strategy']:<5} {row['comm_dtype']:<5} "
+              f"wire {human_bytes(row.get('wire_bytes'))}"
+              + (f" ({ratio * 100:.1f}% of f32)" if ratio is not None else "")
+              + f"   {human_count(row.get('tokens_per_sec_per_chip'))} tok/s/chip"
+              + (f"   dloss vs f32 {delta:+.4g}" if delta is not None else "")
+              + ("" if match is None
+                 else ("   audit OK" if match else "   audit <- MISMATCH"))
+              + ("" if not warns else f"   remat warnings {warns}!"))
+            if row["comm_dtype"] == "int8" and ratio:
+                int8_ratios.append(ratio)
+        if int8_ratios:
+            cut = 1.0 / (sum(int8_ratios) / len(int8_ratios))
+            w(f"  headline: int8 payloads move ~{cut:.1f}x fewer bytes on "
+              f"the wire than f32 (mean over strategy rungs)")
     # round-11 dispatch ladder (ROADMAP #3): the three MoE dataflows side
     # by side at e8 top-1/top-2, MFU normalized by ACTIVE FLOPs (top_k
     # experts + router per token) so padding/dispatch waste reads as lost

@@ -169,6 +169,21 @@ class TrainFlags:
     # the moe_e8 throughput path). "xla" restores the round-5
     # einsum-and-GSPMD behavior for comparison.
     moe_dispatch: str = "a2a"
+    # Collective payload dtype (round 12, tpukit/ops/quant_comm.py —
+    # EQuARX-style block-scaled quantized collectives). "f32" (default)
+    # keeps the exact pre-round-12 collectives; "bf16"/"int8" compress the
+    # wire payload of the strategies with hand-wired quantized collectives:
+    # the DDP gradient all-reduce (two-shot: int8 reduce-scatter -> f32
+    # accumulate -> int8 all-gather), the FSDP gradient reduce-scatter
+    # (param all-gathers stay full precision — grads first), and the
+    # ExpertParallel a2a dispatch payload. Optimizer math and master
+    # params stay f32; correctness is gated by a loss-trajectory tolerance
+    # (tests/test_quant_comm.py), not bit parity. Strategies without wired
+    # collectives reject non-f32 values at startup.
+    comm_dtype: str = "f32"
+    # Stochastic rounding for the int8 quantizer (unbiased per element;
+    # default off = round-to-nearest-even).
+    quant_stochastic: bool = False
 
 
 # The canonical 12 flags of every reference recipe (main-single.py:156-167).
@@ -261,6 +276,11 @@ def build_parser(
     )
     parser.add_argument("--io_retries", type=int, default=defaults.io_retries)
     parser.add_argument("--chaos_spec", type=str, default=defaults.chaos_spec)
+    parser.add_argument(
+        "--comm_dtype", choices=("f32", "bf16", "int8"),
+        default=defaults.comm_dtype,
+    )
+    parser.add_argument("--quant_stochastic", action="store_true")
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--scan_layers", action="store_true")
     parser.add_argument("--microbatches", type=int, default=defaults.microbatches)

@@ -156,8 +156,27 @@ class GPTConfig:
     # mask everywhere, so "pallas" capacity mode drops the bit-identical
     # token set the buffer paths drop (tests/test_moe.py).
     moe_capacity: int = 0
+    # Collective payload dtype (tpukit/ops/quant_comm.py, round 12 —
+    # EQuARX-style). "f32" (default): the exact pre-round-12 collectives,
+    # byte-identical HLO. "bf16"/"int8": the strategies with hand-wired
+    # quantized collectives (DataParallel grad psum, FSDP grad
+    # reduce-scatter, ExpertParallel a2a dispatch payload) compress the
+    # wire payload — int8 is block-scaled (per-256-element max-abs f32
+    # scale sidecar packed into the payload) with f32 accumulation and
+    # f32 master params/optimizer throughout. Strategies without wired
+    # collectives reject non-f32 values at validate_config.
+    comm_dtype: str = "f32"  # "f32" | "bf16" | "int8"
+    # Stochastic rounding for the int8 quantizer (floor(x/scale + U[0,1)):
+    # unbiased per element, the EQuARX option against long-horizon rounding
+    # drift). Default OFF — round-to-nearest-even.
+    quant_stochastic: bool = False
 
     def __post_init__(self):
+        if self.comm_dtype not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                f"comm_dtype={self.comm_dtype!r} must be 'f32', 'bf16' or "
+                f"'int8'"
+            )
         if self.num_experts > 0 and not (1 <= self.router_top_k <= self.num_experts):
             raise ValueError(
                 f"router_top_k={self.router_top_k} must be in [1, "

@@ -60,4 +60,5 @@ from tpukit.obs.xla import (  # noqa: F401
     compiled_stats,
     count_involuntary_remat,
     live_memory_stats,
+    wire_bytes,
 )

@@ -127,6 +127,40 @@ def collective_bytes(hlo_text: str) -> dict[str, dict[str, int]]:
     return out
 
 
+def wire_bytes(collectives: dict[str, dict[str, int]], world: int) -> int:
+    """Estimated bytes each device actually moves over the interconnect
+    for the parsed collectives, from their RESULT payloads (what
+    `collective_bytes` reports) via the standard ring-algorithm cost
+    model. Needed because result bytes are not comparable ACROSS op kinds:
+    a reduce-scatter's result is 1/world of the data it moved, an
+    all-reduce moves ~2x its result (reduce-scatter + all-gather phases).
+    Per-device wire cost for result payload R on a `world`-way ring:
+
+      all-reduce         2 * R * (world-1)/world   (RS + AG phases)
+      all-gather             R * (world-1)/world
+      all-to-all             R * (world-1)/world
+      reduce-scatter         R * (world-1)          (result is 1/world)
+      collective-permute     R                      (one hop)
+
+    This is the denominator-normalizer for the quantized-collective
+    headline (bench.py's quant_comm record, tests): "int8 moves <= 30% of
+    the f32 wire bytes" compares ring-model wire, not raw result sizes."""
+    if world <= 1:
+        return 0
+    frac = (world - 1) / world
+    mult = {
+        "all-reduce": 2.0 * frac,
+        "all-gather": frac,
+        "all-to-all": frac,
+        "reduce-scatter": float(world - 1),
+        "collective-permute": 1.0,
+    }
+    total = 0.0
+    for op, rec in collectives.items():
+        total += rec.get("bytes", 0) * mult.get(op, 1.0)
+    return int(total)
+
+
 # The GSPMD partitioner's last-resort warning (spmd_partitioner.cc): it
 # could not move a tensor between two shardings efficiently, so it
 # REPLICATES the full tensor and re-partitions — for MoE dispatch that is

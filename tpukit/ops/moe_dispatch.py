@@ -55,6 +55,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from tpukit.compat import shard_map
+from tpukit.ops import quant_comm
 
 
 def moe_capacity(cfg, seq_len: int) -> int:
@@ -272,15 +273,24 @@ def _moe_ffn_exchange(layer, cfg, x, pad_mask, expert_ffn, name):
         expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, xc)
         if ep > 1:
             # exchange: send the expert-block destined for peer j, receive
-            # every peer's block for OUR experts -> [E_local, ep*B_local, C, D]
-            expert_in = jax.lax.all_to_all(
-                expert_in, "expert", split_axis=0, concat_axis=1, tiled=True
+            # every peer's block for OUR experts -> [E_local, ep*B_local, C, D].
+            # cfg.comm_dtype selects the wire payload (quant_comm round 12):
+            # "f32" emits the exact pre-round-12 lax.all_to_all; "int8"
+            # moves block-scaled payloads (scale sidecar packed into the
+            # same op, custom vjp keeps the backward a mirrored exchange —
+            # op schedule unchanged). Routing happened BEFORE the exchange
+            # on exact local values, so quantization perturbs expert
+            # activations, never the discrete routing decisions.
+            expert_in = quant_comm.exchange_all_to_all(
+                expert_in, "expert", ep, "dispatch", dtype=cfg.comm_dtype,
+                stochastic=cfg.quant_stochastic,
             )
         h = expert_ffn(experts_l, expert_in, cfg.compute_dtype)
         if ep > 1:
             # mirrored return trip -> [E, B_local, C, D] back on the source
-            h = jax.lax.all_to_all(
-                h, "expert", split_axis=1, concat_axis=0, tiled=True
+            h = quant_comm.exchange_all_to_all(
+                h, "expert", ep, "combine", dtype=cfg.comm_dtype,
+                stochastic=cfg.quant_stochastic,
             )
         out = jnp.einsum(
             "ebcd,bsec->bsd", h,
@@ -303,7 +313,7 @@ def _moe_ffn_exchange(layer, cfg, x, pad_mask, expert_ffn, name):
 
 
 def expected_a2a(cfg, data_size: int, expert_size: int, global_batch: int,
-                 seq: int) -> dict | None:
+                 seq: int, backend: str | None = None) -> dict | None:
     """Closed-form per-device all-to-all payload of the a2a dispatch — what
     the optimized HLO of one step must show (the audit side of
     hand-scheduling the collective).
@@ -315,9 +325,20 @@ def expected_a2a(cfg, data_size: int, expert_size: int, global_batch: int,
     instances*: the scanned layer stack (cfg.scan_layers) emits each op
     once in the scan body regardless of depth, so `layers_visible` is 1
     there. A 1-way expert axis moves nothing (the block skips the
-    collective). Returns {"buffer_bytes", "train": {count, bytes},
-    "eval": {count, bytes}} — eval uses bf16 (the always-on eval autocast)
-    and is forward-only."""
+    collective). Returns {"buffer_bytes", "train": {count, bytes, wire},
+    "eval": {...}} — eval uses bf16 (the always-on eval autocast) and is
+    forward-only.
+
+    Payload dtype (round 12): with cfg.comm_dtype "int8" every exchange op
+    moves the PACKED block-scaled buffer (int8 values + bitcast f32 scale
+    sidecar, quant_comm.packed_bytes — op counts unchanged); "bf16" casts
+    the buffer; "f32" is the raw compute-dtype buffer. `backend` resolves
+    the dtype each payload actually travels at: XLA:CPU's float
+    normalization upcasts bf16 buffers to f32 on the wire (the round-10
+    eval-audit divergence, now priced into the formula instead of excused
+    by the renderer), while int8 payloads audit exactly everywhere. Pass
+    backend=None for nominal accelerator sizes (the pre-round-12
+    behavior)."""
     if cfg.num_experts <= 0:
         return None
     zero = {"count": 0, "bytes": 0}
@@ -328,24 +349,41 @@ def expected_a2a(cfg, data_size: int, expert_size: int, global_batch: int,
     if global_batch % rows:
         return None  # undividable batch never reaches the a2a path
     b_local = global_batch // rows
+    n_buf = cfg.num_experts * b_local * capacity * cfg.dim  # buffer elems
     layers_visible = 1 if cfg.scan_layers else cfg.num_layers
     train_ops = 6 if cfg.remat_layers else 4
+    comm = getattr(cfg, "comm_dtype", "f32")
 
-    def bytes_for(dtype, ops_per_layer):
-        buf = (
-            cfg.num_experts * b_local * capacity * cfg.dim
-            * jnp.dtype(dtype).itemsize
-        )
-        return {
-            "count": ops_per_layer * layers_visible,
-            "bytes": ops_per_layer * layers_visible * buf,
-        }
+    def op_bytes(compute_dtype):
+        """Result bytes of ONE exchange op, comm/backend-aware."""
+        if comm == "int8":
+            # ep packed rows, each covering the destination group's elems
+            return expert_size * quant_comm.packed_bytes(n_buf // expert_size)
+        if comm == "bf16":
+            return n_buf * quant_comm.wire_itemsize("bf16", backend)
+        name = jnp.dtype(compute_dtype).name
+        if name == "bfloat16":
+            return n_buf * quant_comm.wire_itemsize("bf16", backend)
+        return n_buf * jnp.dtype(compute_dtype).itemsize
+
+    def wire_name(compute_dtype):
+        if comm == "int8":
+            return "s8-packed"
+        if comm == "bf16" or jnp.dtype(compute_dtype).name == "bfloat16":
+            return "f32" if backend == "cpu" else "bf16"
+        return jnp.dtype(compute_dtype).name
+
+    def entry(compute_dtype, ops_per_layer):
+        count = ops_per_layer * layers_visible
+        rec = {"count": count, "bytes": count * op_bytes(compute_dtype)}
+        if backend is not None:
+            # marker: this expectation already prices in the backend's
+            # wire dtype — renderers must compare EXACTLY, no CPU excuse
+            rec["wire"] = wire_name(compute_dtype)
+        return rec
 
     return {
-        "buffer_bytes": (
-            cfg.num_experts * b_local * capacity * cfg.dim
-            * jnp.dtype(cfg.compute_dtype).itemsize
-        ),
-        "train": bytes_for(cfg.compute_dtype, train_ops),
-        "eval": bytes_for(jnp.bfloat16, 2),
+        "buffer_bytes": n_buf * jnp.dtype(cfg.compute_dtype).itemsize,
+        "train": entry(cfg.compute_dtype, train_ops),
+        "eval": entry(jnp.bfloat16, 2),
     }

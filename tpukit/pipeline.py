@@ -163,6 +163,7 @@ class Pipeline(Strategy):
             )
 
     def validate_config(self, cfg: gpt.GPTConfig) -> None:
+        self._validate_comm_dtype(cfg)
         if cfg.num_layers < 1:
             raise ValueError(f"num_layers must be >= 1, got {cfg.num_layers}")
         self._reject_moe(cfg)

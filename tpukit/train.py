@@ -135,7 +135,16 @@ def make_step_fns(
     exactly the flag-free one, so the compiled HLO is byte-identical.
     """
     eval_cfg = cfg.replace(compute_dtype=jnp.bfloat16)  # eval autocast always on
-    dropout_key = jax.random.PRNGKey(seed ^ 0x5EED) if cfg.dropout > 0 else None
+    # The per-step key feeds dropout AND (round 12) the stochastic-rounding
+    # noise of the DataParallel quantized grad psum — the one SR site a key
+    # can be threaded into (FSDP's and EP's SR noise lives inside custom-vjp
+    # backwards, which derive step-varying keys from the cotangent data
+    # instead: quant_comm._fallback_key). With dropout 0 the rate-0 dropout
+    # is an identity, so the SR-only case changes nothing but the rounding.
+    needs_rng = cfg.dropout > 0 or (
+        cfg.quant_stochastic and cfg.comm_dtype == "int8"
+    )
+    dropout_key = jax.random.PRNGKey(seed ^ 0x5EED) if needs_rng else None
 
     def train_step(state: TrainState, batch, targets):
         state = strategy.to_compute(state)
@@ -445,6 +454,8 @@ def _fit_body(
         scan_layers=flags.scan_layers,
         num_experts=flags.num_experts,
         router_top_k=flags.moe_top_k,
+        comm_dtype=flags.comm_dtype,
+        quant_stochastic=flags.quant_stochastic,
     )
     optimizer = make_optimizer(flags.learning_rate)
     strategy.validate_config(cfg)  # fail fast with a clear shape/mesh error
@@ -644,7 +655,11 @@ def _fit_body(
             )
             stats = compiled_stats(jitted, *structs)
         if stats:
-            expected = getattr(strategy, "comm_ops", ())
+            ops_for = getattr(strategy, "comm_ops_for", None)
+            expected = (
+                ops_for(cfg) if ops_for is not None
+                else getattr(strategy, "comm_ops", ())
+            )
             extra = {}
             # Hand-scheduled dispatch audit (round 10): strategies that
             # place their own collectives (ExpertParallel's a2a MoE
@@ -654,10 +669,28 @@ def _fit_body(
             audit_fn = getattr(strategy, "dispatch_comm", None)
             if audit_fn is not None:
                 ids = call_args[1]["input_ids"]
-                audit = audit_fn(cfg, global_batch=ids.shape[0], seq=ids.shape[1])
+                # backend-aware expectation (round 12): the formula prices
+                # in XLA:CPU's bf16->f32 wire upcast, so the renderer can
+                # compare bytes EXACTLY on every backend instead of
+                # soft-excusing CPU eval windows
+                audit = audit_fn(
+                    cfg, global_batch=ids.shape[0], seq=ids.shape[1],
+                    backend=jax.default_backend(),
+                )
                 if audit:
                     key = "train" if fn_name == "train_step" else "eval"
                     extra["a2a_expected"] = audit[key]
+            # quantized grad-collective audit (round 12): DDP/FSDP predict
+            # their compressed grad payload in closed form; the record
+            # carries it next to the measured HLO bytes
+            grad_fn = getattr(strategy, "grad_comm", None)
+            if grad_fn is not None and fn_name == "train_step":
+                gaudit = grad_fn(
+                    cfg, state_shapes.params, backend=jax.default_backend()
+                )
+                if gaudit:
+                    extra["quant_grad_expected"] = gaudit
+                    extra["comm_dtype"] = cfg.comm_dtype
             logger.log(
                 kind="xla", fn=fn_name, strategy=strategy.name,
                 backend=jax.default_backend(),

@@ -26,7 +26,10 @@ flips the headline moe_e8 probe onto the kernel path. The `quant_comm`
 record (round 12, ROADMAP #2) measures `--comm_dtype` f32 vs bf16 vs int8
 per strategy rung (ddp/fsdp/ep): expected+measured bytes-on-the-wire (the
 ~4x int8 cut is the headline), tokens/s/chip, and the final-loss delta vs
-f32 — the tolerance-gate number.
+f32 — the tolerance-gate number. The `elastic_restore` record (round 13,
+ROADMAP #5) measures the reshard-on-restore pass: a sharded FSDP
+checkpoint landing on a half-size world — wall-clock, bytes read, host
+RSS high-water delta, and the byte-parity bit vs a direct restore.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -350,6 +353,94 @@ def bench_moe_dispatch_ladder(cfg, n_dev, num_experts=8, steps=8):
                     file=sys.stderr,
                 )
     return rows
+
+
+def bench_elastic_restore(cfg, n_dev):
+    """Elastic restore probe (round 13, ROADMAP #5): save a sharded FSDP
+    checkpoint over all chips, then restore it two ways — direct (same
+    world) and RESHARDED onto a half-size mesh (tpukit/reshard.py) — and
+    record what an elastic relaunch costs:
+
+      - restore+reshard wall-clock and bytes/blocks read (the streaming
+        reader should read each byte once);
+      - peak host RSS delta across the reshard (ru_maxrss high-water),
+        plus `rss_overhead_bytes` = delta minus the state's own bytes:
+        on CPU backends the restored arrays themselves live in process
+        heap, so the DELTA is ~state_bytes on every healthy run — the
+        OVERHEAD is the signal. The streaming pass bounds scratch memory
+        by one leaf's blocks, so overhead near zero is healthy and
+        overhead near +state_bytes means a second full copy was
+        materialized (the regression this probe exists to catch);
+      - a parity bit: the resharded state's leaves must be BYTE-identical
+        to the direct restore's (resharding moves data, never math).
+
+    Needs >= 2 chips to have a smaller world to land on; on one chip the
+    record carries an honest error instead of a faked number."""
+    import resource
+    import shutil
+    import tempfile
+
+    import jax
+
+    from tools.bench_ladder import setup_step
+    from tpukit import checkpoint as ckpt_lib
+    from tpukit import reshard as reshard_lib
+    from tpukit.mesh import create_mesh
+    from tpukit.shardings import FSDP
+
+    if n_dev < 2:
+        return {"error": "needs >= 2 chips (no smaller world to reshard onto)"}
+    src = FSDP(create_mesh({"data": n_dev}))
+    _, state, shapes, _ = setup_step(cfg, src)
+    state_bytes = sum(
+        l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(state)
+    )
+    ckdir = tempfile.mkdtemp(prefix="tpukit-bench-resize-")
+    try:
+        path = ckpt_lib.save_sharded(
+            state, ckdir, meta={"world": reshard_lib.current_world(src)}
+        )
+        tgt = FSDP(create_mesh({"data": n_dev // 2}, jax.devices()[: n_dev // 2]))
+        t_sharding = tgt.state_sharding(shapes)
+        # reshard FIRST, bracketed by the RSS high-water reads, so the
+        # direct (parity-reference) restore's allocations cannot inflate
+        # the delta attributed to the streaming pass
+        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        resized, info = reshard_lib.reshard_restore(path, shapes, t_sharding)
+        rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        direct, _ = reshard_lib.reshard_restore(
+            path, shapes, src.state_sharding(shapes)
+        )
+        parity = all(
+            np.asarray(jax.device_get(a)).tobytes()
+            == np.asarray(jax.device_get(b)).tobytes()
+            for a, b in zip(
+                jax.tree_util.tree_leaves(resized),
+                jax.tree_util.tree_leaves(direct),
+            )
+        )
+        del state, direct, resized
+        # ru_maxrss is KiB on Linux, bytes on macOS; normalize to bytes
+        rss_delta = int(
+            (rss1 - rss0) * (1 if sys.platform == "darwin" else 1024)
+        )
+        return {
+            "from_world": {"strategy": "fsdp", "devices": n_dev},
+            "to_world": {"strategy": "fsdp", "devices": n_dev // 2},
+            "state_bytes": int(state_bytes),
+            "restore_wall_s": round(info["wall_s"], 4),
+            "bytes_read": int(info["bytes_read"]),
+            "blocks_read": int(info["blocks_read"]),
+            "peak_rss_delta_bytes": rss_delta,
+            # the signal: scratch above the restored state's own residency
+            # (on CPU the restored arrays ARE host RAM; on TPU they are
+            # not, and overhead simply reads lower — still comparable
+            # across rounds on the same backend)
+            "rss_overhead_bytes": rss_delta - int(state_bytes),
+            "parity_ok": bool(parity),
+        }
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
 
 
 def bench_quant_comm(cfg, n_dev, num_experts=8, steps=8):
@@ -680,6 +771,16 @@ def main(argv=None):
         quant_comm_rec = [{"strategy": "quant_comm", "error": repr(exc)}]
         print(f"quant comm ladder failed: {exc!r}", file=sys.stderr)
 
+    # Elastic restore (round 13, ROADMAP #5): restore+reshard wall-clock,
+    # bytes read, RSS high-water delta and the parity bit for a sharded
+    # checkpoint landing on a half-size world.
+    elastic_restore = None
+    try:
+        elastic_restore = bench_elastic_restore(cfg, n_dev)
+    except Exception as exc:
+        elastic_restore = {"error": repr(exc)}
+        print(f"elastic restore probe failed: {exc!r}", file=sys.stderr)
+
     # Host input pipeline (round 7): sync data+h2d share vs the depth-2
     # prefetcher's residual stall share, with loss-parity proof.
     host_pipeline, host_pipeline_err = None, None
@@ -734,6 +835,7 @@ def main(argv=None):
         "moe_ep_comm_error": moe_ep_comm_err,
         "moe_dispatch_ladder": moe_dispatch_ladder,
         "quant_comm": quant_comm_rec,
+        "elastic_restore": elastic_restore,
         "host_pipeline": host_pipeline,
         "host_pipeline_error": host_pipeline_err,
         "obs_overhead": obs_overhead,

@@ -26,7 +26,10 @@ active-FLOPs-normalized MFU — ROADMAP #3). Round 12 adds the quantized
 grad-collective audit line to the "xla" section (--comm_dtype: the
 closed-form compressed payload vs the compiled HLO, dtype-aware so it is
 exact on CPU too) and renders bench.py's `quant_comm` record with the
-bytes-on-the-wire headline. This tool needs NOTHING but
+bytes-on-the-wire headline. Round-13 elastic resize adds "resize"
+(reshard-on-restore: the topology change, bytes read, stale files swept)
+and "ckpt_prune" (--keep_checkpoints retention) to the recovery section,
+plus bench.py's `elastic_restore` record. This tool needs NOTHING but
 the file — no jax import, so it runs anywhere the log was copied to.
 
 Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
@@ -255,13 +258,27 @@ def summarize(records: list[dict]) -> str:
         for r in stragglers:
             w(f"  step {r.get('step', '?')}: {r.get('stragglers')}")
     # round-9 recovery: in-process rollbacks, graceful preemption, retried
-    # transient I/O, and the chaos audit trail
+    # transient I/O, and the chaos audit trail; round-13 elastic resizes
+    # (reshard-on-restore) and checkpoint-retention prunes render here too
+    # — recovery is the section an operator reads after a relaunch, and a
+    # topology change IS a recovery event.
     rollbacks = _rows(records, "rollback")
     preempts = _rows(records, "preempt")
     retries = _rows(records, "retry")
     chaos = _rows(records, "chaos")
-    if rollbacks or preempts or retries or chaos:
+    resizes = _rows(records, "resize")
+    prunes = _rows(records, "ckpt_prune")
+    if rollbacks or preempts or retries or chaos or resizes or prunes:
         w("== recovery ==")
+    for r in resizes:
+        w(f"  resized: {r.get('mismatch', '?')} — resumed step "
+          f"{r.get('step', '?')} from {r.get('checkpoint', '?')} "
+          f"({r.get('format', '?')} reshard, "
+          f"{human_bytes(r.get('bytes_read'))} in "
+          f"{r.get('blocks_read', '?')} blocks, {r.get('wall_s', '?')}s"
+          + (f"; swept {len(r['swept'])} stale file(s)"
+             if r.get("swept") else "")
+          + ")")
     if rollbacks:
         lost = sum(r.get("steps_lost", 0) for r in rollbacks)
         w(f"  rollbacks: {len(rollbacks)}   total steps lost: {lost}")
@@ -292,6 +309,10 @@ def summarize(records: list[dict]) -> str:
           + ", ".join(
               f"{r.get('fault', '?')}@{r.get('occurrence', r.get('step', '?'))}"
               for r in chaos) + ")")
+    if prunes:
+        total = sum(len(r.get("pruned") or []) for r in prunes)
+        w(f"  checkpoint retention: {total} pruned over {len(prunes)} "
+          f"sweep(s) (--keep_checkpoints {prunes[-1].get('keep', '?')})")
     # round-8 failure observability: hang-watchdog events, cross-replica
     # divergence, anomaly-trace lifecycle
     watchdog = _rows(records, "watchdog")
@@ -394,6 +415,32 @@ def summarize(records: list[dict]) -> str:
             cut = 1.0 / (sum(int8_ratios) / len(int8_ratios))
             w(f"  headline: int8 payloads move ~{cut:.1f}x fewer bytes on "
               f"the wire than f32 (mean over strategy rungs)")
+    # round-13 elastic restore (ROADMAP #5): what a reshard-on-restore
+    # relaunch costs — wall-clock, bytes read, host RSS high-water delta,
+    # and the byte-parity bit vs a direct restore. Rendered under the
+    # recovery banner: a topology change is a recovery event.
+    for r in records:
+        er = r.get("elastic_restore")
+        if not isinstance(er, dict):
+            continue
+        w("== recovery: elastic restore (bench) ==")
+        if "error" in er:
+            w(f"  ERROR {er['error']}")
+            continue
+        fw, tw = er.get("from_world") or {}, er.get("to_world") or {}
+        w(f"  {fw.get('strategy', '?')}@{fw.get('devices', '?')} -> "
+          f"{tw.get('strategy', '?')}@{tw.get('devices', '?')}: "
+          f"{er.get('restore_wall_s', '?')}s   "
+          f"read {human_bytes(er.get('bytes_read'))} in "
+          f"{er.get('blocks_read', '?')} blocks "
+          f"(state {human_bytes(er.get('state_bytes'))})")
+        overhead = er.get("rss_overhead_bytes")
+        w(f"  host RSS high-water delta: "
+          f"{human_bytes(er.get('peak_rss_delta_bytes'))}"
+          + (f" (scratch overhead above resident state: "
+             f"{human_bytes(overhead)})" if overhead is not None else "")
+          + "   parity vs direct restore: "
+          + ("OK" if er.get("parity_ok") else "<- MISMATCH"))
     # round-11 dispatch ladder (ROADMAP #3): the three MoE dataflows side
     # by side at e8 top-1/top-2, MFU normalized by ACTIVE FLOPs (top_k
     # experts + router per token) so padding/dispatch waste reads as lost

@@ -25,6 +25,13 @@ step-indexed kinds (`@N` = fires when training step N completes):
     bitflip@N[:p]     flip one mantissa bit of the first parameter leaf on
                       process p (default: the last process) — a divergent
                       replica for the checksum detector
+    resize@N:M        elastic world resize (round 13): force a graceful
+                      preempt-save at step N (in-process SIGTERM, exit 75)
+                      whose resume metadata records the TARGET world M —
+                      the relaunch at M devices must RESHARD the
+                      checkpoint (tpukit/reshard.py) and fit() raises if
+                      it comes back at any other world, so a resize chaos
+                      run asserts the elastic path instead of hoping
     skip@N            consume (discard) the first N batches of the first
                       trained epoch before training starts — the stream
                       fast-forward primitive, exposed so a control run can
@@ -51,7 +58,9 @@ import signal
 import threading
 import time
 
-STEP_KINDS = ("nan_loss", "spike_loss", "sigterm", "sigint", "hang", "bitflip")
+STEP_KINDS = (
+    "nan_loss", "spike_loss", "sigterm", "sigint", "hang", "bitflip", "resize",
+)
 IO_KINDS = ("ckpt_io_fail", "ckpt_read_fail", "loader_io_fail")
 # io-site label (as used by the checkpoint/loader call sites) per kind
 _IO_SITE = {
@@ -108,6 +117,13 @@ def parse_spec(spec: str) -> list[dict]:
             raise ChaosSpecError(
                 f"chaos spec entry {raw!r}: spike multiplier must be > 0"
             )
+        if kind == "resize":
+            p = entry["param"]
+            if p is None or p != int(p) or int(p) < 1:
+                raise ChaosSpecError(
+                    f"chaos spec entry {raw!r}: resize needs an integer "
+                    f"target world size >= 1 (resize@N:M)"
+                )
         if kind in IO_KINDS:
             if entry["at"] < 1:
                 raise ChaosSpecError(
@@ -147,6 +163,9 @@ class ChaosEngine:
         self._io_plan: dict[str, dict[int, int]] = {s: {} for s in _IO_SITE.values()}
         self._io_seen: dict[str, int] = {s: 0 for s in _IO_SITE.values()}
         self.skip_batches = 0
+        # resize@N:M — set when the fault FIRES (the preempt-save's resume
+        # metadata records it as `resize_to`, what the relaunch asserts)
+        self.resize_target: int | None = None
         for e in parse_spec(spec):
             if e["kind"] == "bitflip" and e["param"] is not None and not (
                 0 <= int(e["param"]) < process_count
@@ -194,6 +213,13 @@ class ChaosEngine:
                 loss = self._poison_loss(loss, None, mult=param or 1e3)
                 ev["mult"] = param or 1e3
             elif kind == "sigterm":
+                signal.raise_signal(signal.SIGTERM)
+            elif kind == "resize":
+                # same graceful-preemption machinery as sigterm@N; the
+                # target world rides the preempt checkpoint's resume
+                # metadata so the relaunch can ASSERT it resharded to M
+                self.resize_target = int(param)
+                ev["to"] = int(param)
                 signal.raise_signal(signal.SIGTERM)
             elif kind == "sigint":
                 signal.raise_signal(signal.SIGINT)

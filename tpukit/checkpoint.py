@@ -140,6 +140,149 @@ def _publish_sidecars(path: Path, digest: str, meta: dict | None) -> None:
         _atomic_write_text(meta_path(path), json.dumps(meta))
 
 
+def _parse_block_key(key: str) -> tuple[int, list[int]]:
+    """Parse a shard block key `"<leaf-idx>|<start,start,...>"` (the format
+    `_shard_blocks` writes; empty starts = scalar leaf). The ONE spelling
+    of the key format — shared by the geometry check, `restore_sharded`
+    and the elastic reshard pass (tpukit/reshard.py), so a format change
+    cannot desynchronize save, verify and restore. Raises ValueError on a
+    malformed key."""
+    idx_s, _, starts_s = key.partition("|")
+    starts = [int(s) for s in starts_s.split(",")] if starts_s else []
+    return int(idx_s), starts
+
+
+def _read_shard_manifest(base: Path) -> tuple[dict, list[Path]]:
+    """manifest.json (retried read) + exactly the shard files its recorded
+    world wrote, existence-checked — a stale extra shard-*.npz (e.g. from
+    a crashed save under a different world size, on a filesystem where
+    the pre-save cleanup could not see it) must never be read into a
+    restore. Shared by `restore_sharded` and the elastic reshard pass."""
+    manifest = json.loads(
+        retry_io(_read_blob, base / "manifest.json", label="ckpt_read")
+    )
+    shard_files = [
+        base / f"shard-{pid:05d}.npz" for pid in range(manifest["nprocs"])
+    ]
+    missing = [str(f) for f in shard_files if not f.exists()]
+    if missing:
+        raise FileNotFoundError(
+            f"checkpoint {base}: missing shard files {missing} (saved from "
+            f"{manifest['nprocs']} processes; are all shard files on this "
+            f"filesystem?)"
+        )
+    return manifest, shard_files
+
+
+def _sharding_leaves(template_flat, sharding_tree) -> list:
+    """Per-leaf target shardings: `sharding_tree`'s Sharding leaves, or the
+    template leaves' own (None for plain host arrays). Shared by
+    `restore_sharded` and the reshard pass."""
+    if sharding_tree is None:
+        return [getattr(l, "sharding", None) for l in template_flat]
+    return jax.tree_util.tree_leaves(
+        sharding_tree, is_leaf=lambda x: isinstance(x, jax.sharding.Sharding)
+    )
+
+
+def _npz_block_headers(path: Path):
+    """Yield (key, shape, dtype) for every array in an npz WITHOUT reading
+    array data — the shapes come from the npy headers inside the zip, so
+    checking a multi-GB shard's block geometry costs kilobytes of I/O."""
+    import zipfile
+
+    from numpy.lib import format as npformat
+
+    with zipfile.ZipFile(path) as zf:
+        for name in zf.namelist():
+            key = name[:-4] if name.endswith(".npy") else name
+            with zf.open(name) as fp:
+                version = npformat.read_magic(fp)
+                shape, _, dtype = npformat._read_array_header(fp, version)
+            yield key, tuple(shape), dtype
+
+
+def _check_shard_geometry(shard_files: list[Path], manifest: dict) -> str | None:
+    """Cross-check the shard files' block geometry against the manifest's
+    recorded per-leaf global shapes/dtypes. Returns a detail string on
+    mismatch (geometry-shaped failures carry a `world mismatch:` prefix,
+    plain I/O damage does not — the two point an operator at different
+    triage paths), None when everything fits and covers exactly.
+
+    This is what catches a manifest paired with shards from a DIFFERENT
+    world (a stale shard file surviving a crashed save at another world
+    size, or an operator copying shards between runs): the per-file
+    checksums only prove each file is internally intact, while the
+    geometry check proves the set of blocks actually tiles the state the
+    manifest describes. Duplicate (leaf, starts) keys ACROSS shard files
+    are rejected outright — element counts alone would let a duplicate
+    block mask a missing one exactly. Header-only reads — no array data
+    is touched."""
+    leaves = manifest.get("leaves")
+    if leaves is None:
+        return None  # foreign/minimal manifest: nothing to check against
+    paths = manifest.get("paths") or [str(i) for i in range(len(leaves))]
+    covered = [0] * len(leaves)
+    seen: set[tuple[int, tuple[int, ...]]] = set()
+    for f in shard_files:
+        try:
+            headers = list(_npz_block_headers(f))
+        except Exception as exc:  # zip/npy damage: verify as unreadable
+            return f"unreadable shard {f.name} ({exc})"
+        for key, bshape, bdtype in headers:
+            try:
+                i, starts = _parse_block_key(key)
+            except ValueError:
+                return f"{f.name}: malformed block key {key!r}"
+            if not 0 <= i < len(leaves):
+                return (
+                    f"world mismatch: {f.name} block {key!r} references "
+                    f"leaf {i} but the manifest records {len(leaves)} "
+                    f"leaves — shards from a different world?"
+                )
+            block_id = (i, tuple(starts))
+            if block_id in seen:
+                return (
+                    f"world mismatch: duplicate block {key!r} across shard "
+                    f"files ({f.name}) — shards from a different world "
+                    f"mixed in?"
+                )
+            seen.add(block_id)
+            shape = tuple(leaves[i]["shape"])
+            if len(bshape) != len(shape) or len(starts) != len(shape) or any(
+                st + bs > dim for st, bs, dim in zip(starts, bshape, shape)
+            ):
+                return (
+                    f"world mismatch: {f.name} block {key!r} shape {bshape} "
+                    f"at offset {tuple(starts)} does not fit the manifest's "
+                    f"global shape {shape} for leaf {paths[i]} — shards "
+                    f"from a different world?"
+                )
+            import numpy as np
+
+            if np.dtype(bdtype) != np.dtype(leaves[i]["dtype"]):
+                return (
+                    f"world mismatch: {f.name} block {key!r} dtype "
+                    f"{np.dtype(bdtype)} != manifest dtype "
+                    f"{leaves[i]['dtype']} for leaf {paths[i]}"
+                )
+            n = 1
+            for d in bshape:
+                n *= int(d)
+            covered[i] += n
+    for i, got in enumerate(covered):
+        want = 1
+        for d in leaves[i]["shape"]:
+            want *= int(d)
+        if got != want:
+            return (
+                f"world mismatch: leaf {paths[i]} has {got}/{want} elements "
+                f"across the manifest's {manifest.get('nprocs')} shard "
+                f"files — shards from a different world?"
+            )
+    return None
+
+
 def verify_checkpoint(path: str | os.PathLike) -> tuple[bool, str]:
     """Integrity check of either format. Returns (ok, detail).
 
@@ -147,8 +290,12 @@ def verify_checkpoint(path: str | os.PathLike) -> tuple[bool, str]:
     sidecar is accepted as "unverified legacy" (pre-round-9 checkpoints
     remain restorable) but a PRESENT, mismatching one fails. Sharded: the
     manifest must exist/parse, every shard file of the manifest's world
-    must exist, and (when the manifest records `checksums`) each shard
-    file's sha256 must match.
+    must exist, (when the manifest records `checksums`) each shard file's
+    sha256 must match, AND the shards' block geometry must tile exactly
+    the per-leaf global shapes the manifest records (round 13: the
+    checksums prove each file is intact, the geometry check proves the
+    set of files belongs to THIS manifest's world — a stale shard from a
+    save at a different world size fails here with a named detail).
 
     Never raises on I/O: a candidate can VANISH mid-verification (a
     lagging rank's `latest_good` scan races process 0's quarantine
@@ -169,18 +316,22 @@ def verify_checkpoint(path: str | os.PathLike) -> tuple[bool, str]:
         if missing:
             return False, f"missing shard files {missing}"
         checksums = manifest.get("checksums")
+        if checksums is not None:
+            for f in shard_files:
+                want = checksums.get(f.name)
+                if want is None:
+                    return False, f"manifest has no checksum for {f.name}"
+                try:
+                    got = _sha256_file(f)
+                except OSError as exc:
+                    return False, f"unreadable shard {f.name} ({exc})"
+                if got != want:
+                    return False, f"checksum mismatch in {f.name}"
+        geo = _check_shard_geometry(shard_files, manifest)
+        if geo is not None:
+            return False, geo
         if checksums is None:
             return True, "unverified (manifest has no checksums; legacy)"
-        for f in shard_files:
-            want = checksums.get(f.name)
-            if want is None:
-                return False, f"manifest has no checksum for {f.name}"
-            try:
-                got = _sha256_file(f)
-            except OSError as exc:
-                return False, f"unreadable shard {f.name} ({exc})"
-            if got != want:
-                return False, f"checksum mismatch in {f.name}"
         return True, "verified"
     if not path.exists():
         return False, "missing file"
@@ -424,6 +575,69 @@ def latest_good(
     return None
 
 
+def prune_checkpoints(
+    directory: str | os.PathLike = "checkpoints", keep: int = 1,
+    assume_newest_verified: bool = False,
+) -> list[str]:
+    """Retention (round 13, `--keep_checkpoints K`): delete published
+    checkpoints older than the newest `keep`, so long elastic runs don't
+    exhaust disk. Two classes of checkpoint are never pruned:
+
+      - quarantined timelines: `RecoveryEngine.quarantine` renames suspect
+        checkpoints to `*.quarantined-NNNN`, which no published glob (and
+        therefore `all_checkpoints` here) matches — they are forensic
+        evidence, retention never touches them;
+      - the `latest_good` candidate: when none of the kept (newest)
+        checkpoints passes integrity verification, the newest VERIFIED one
+        outside the keep window must survive — it is the only state a
+        rollback or `--resume latest` could still trust.
+
+    Returns the deleted checkpoint names. Process-0 only on shared
+    filesystems (one unlink/rmtree per checkpoint, like the publish).
+    Deletion failures are skipped, not fatal — a prune miss costs disk,
+    never correctness.
+
+    `assume_newest_verified=True` skips re-verifying the kept set: the
+    trainer prunes right after ITS OWN publish, whose writer computed the
+    checksums from the in-memory bytes moments earlier — re-hashing a
+    multi-GB checkpoint on the training thread every save interval would
+    roughly double per-save disk I/O to defend against same-second
+    bitrot. Standalone callers (a janitor over a foreign directory) keep
+    the full verification."""
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    candidates = all_checkpoints(directory)
+    doomed, kept = candidates[:-keep], candidates[-keep:]
+    if not doomed:
+        return []
+    # `latest_good` protection without hashing the whole directory: if any
+    # KEPT checkpoint verifies (newest-first, usually the first try), the
+    # doomed set holds nothing a resume/rollback would still resolve to;
+    # otherwise spare the newest verified doomed one.
+    if not assume_newest_verified and not any(
+        verify_checkpoint(p)[0] for p in reversed(kept)
+    ):
+        for p in reversed(doomed):
+            if verify_checkpoint(p)[0]:
+                doomed = [d for d in doomed if d != p]
+                break
+    removed = []
+    for path in doomed:
+        try:
+            if path.is_dir():
+                import shutil
+
+                shutil.rmtree(path)
+            else:
+                path.unlink()
+                checksum_sidecar(path).unlink(missing_ok=True)
+                meta_path(path).unlink(missing_ok=True)
+        except OSError:
+            continue
+        removed.append(path.name)
+    return removed
+
+
 def restore_any(path: str | os.PathLike, template, sharding_tree=None):
     """Restore either format: a `*.sharded` directory goes through
     `restore_sharded` (shards placed straight into `sharding_tree`); a
@@ -646,6 +860,77 @@ def _as_jax_array(x) -> jax.Array:
     return x if isinstance(x, jax.Array) else jnp.asarray(x)
 
 
+class _ShardReader:
+    """One lazy NpzFile handle per shard file (zip metadata only — an eager
+    whole-shard read would hold the entire checkpoint in host RAM on every
+    process), with every deferred block read wrapped in retry_io: a failed
+    read drops the handle so the retry reopens from a clean zip state
+    instead of a poisoned stream position. Shared by `restore_sharded` and
+    the round-13 elastic reshard pass (tpukit/reshard.py), which
+    additionally uses `block_headers()` to plan which blocks intersect a
+    target shard BEFORE reading any array data."""
+
+    def __init__(self, f):
+        self.f = f
+        self._npz = None
+        self._files = None
+        self._headers = None
+
+    def _open(self):
+        chaos_lib.maybe_io_fault("ckpt_read")
+        if self._npz is None:
+            import numpy as np
+
+            self._npz = np.load(self.f)
+        return self._npz
+
+    def close(self):
+        if self._npz is not None:
+            try:
+                self._npz.close()
+            except Exception:
+                pass
+            self._npz = None
+
+    def files(self):
+        if self._files is None:
+
+            def _list():
+                try:
+                    return list(self._open().files)
+                except OSError:
+                    self.close()
+                    raise
+
+            self._files = retry_io(_list, label="ckpt_read")
+        return self._files
+
+    def block_headers(self) -> dict:
+        """{key: (shape, dtype)} from the npy headers — no array data is
+        read, so planning a reshard over a multi-GB shard costs KBs."""
+        if self._headers is None:
+
+            def _read():
+                chaos_lib.maybe_io_fault("ckpt_read")
+                return {
+                    key: (shape, dtype)
+                    for key, shape, dtype in _npz_block_headers(self.f)
+                }
+
+            self._headers = retry_io(_read, label="ckpt_read")
+        return self._headers
+
+    def read(self, key):
+        def _read():
+            try:
+                return self._open()[key]
+            except OSError:
+                self.close()
+                raise
+
+        return retry_io(_read, label="ckpt_read")
+
+
 def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
     """Restore a sharded checkpoint into the structure of `template`,
     placing each leaf with `sharding_tree` (defaults to the template
@@ -654,90 +939,19 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
     axes (uneven pipeline layouts) are sliced/zero-padded to the template's
     layer count (_adapt_layer_axis) — so pipe -> single restores work even
     for uneven layer counts."""
-    import json
-
     import numpy as np
 
     base = Path(path)
-    manifest = json.loads(
-        retry_io(_read_blob, base / "manifest.json", label="ckpt_read")
-    )
-    # Exactly the files the manifest's world wrote — a stale extra
-    # shard-*.npz (e.g. from a crashed save under a different world size,
-    # on a filesystem where the pre-save cleanup could not see it) must not
-    # be read into the restore.
-    shard_files = [
-        base / f"shard-{pid:05d}.npz" for pid in range(manifest["nprocs"])
-    ]
-    missing = [str(f) for f in shard_files if not f.exists()]
-    if missing:
-        raise FileNotFoundError(
-            f"checkpoint {base}: missing shard files {missing} (saved from "
-            f"{manifest['nprocs']} processes; are all shard files on this "
-            f"filesystem?)"
-        )
-    class _Shard:
-        # One lazy NpzFile handle per shard (zip metadata only — an eager
-        # whole-shard read would hold the entire checkpoint in host RAM on
-        # every process), with every deferred block read wrapped in
-        # retry_io: a failed read drops the handle so the retry reopens
-        # from a clean zip state instead of a poisoned stream position.
-        def __init__(self, f):
-            self.f = f
-            self._npz = None
-            self._files = None
-
-        def _open(self):
-            chaos_lib.maybe_io_fault("ckpt_read")
-            if self._npz is None:
-                self._npz = np.load(self.f)
-            return self._npz
-
-        def close(self):
-            if self._npz is not None:
-                try:
-                    self._npz.close()
-                except Exception:
-                    pass
-                self._npz = None
-
-        def files(self):
-            if self._files is None:
-
-                def _list():
-                    try:
-                        return list(self._open().files)
-                    except OSError:
-                        self.close()
-                        raise
-
-                self._files = retry_io(_list, label="ckpt_read")
-            return self._files
-
-        def read(self, key):
-            def _read():
-                try:
-                    return self._open()[key]
-                except OSError:
-                    self.close()
-                    raise
-
-            return retry_io(_read, label="ckpt_read")
-
+    manifest, shard_files = _read_shard_manifest(base)
     flat, treedef = jax.tree_util.tree_flatten(template)
-    if sharding_tree is None:
-        shardings = [getattr(l, "sharding", None) for l in flat]
-    else:
-        shardings = jax.tree_util.tree_leaves(
-            sharding_tree, is_leaf=lambda x: isinstance(x, jax.sharding.Sharding)
-        )
+    shardings = _sharding_leaves(flat, sharding_tree)
     if len(flat) != len(manifest["leaves"]):
         raise ValueError(
             f"template has {len(flat)} leaves, checkpoint has "
             f"{len(manifest['leaves'])} ({base})"
         )
 
-    readers = [_Shard(f) for f in shard_files]
+    readers = [_ShardReader(f) for f in shard_files]
     restored = []
     for i, (leaf, meta, sharding) in enumerate(zip(flat, manifest["leaves"], shardings)):
         shape, dtype = tuple(meta["shape"]), np.dtype(meta["dtype"])
@@ -750,9 +964,8 @@ def restore_sharded(path: str | os.PathLike, template, sharding_tree=None):
                 if not key.startswith(prefix):
                     continue
                 block = ar.read(key)
-                starts_s = key[len(prefix):]
-                if starts_s:
-                    starts = [int(s) for s in starts_s.split(",")]
+                _, starts = _parse_block_key(key)
+                if starts:
                     idx = tuple(
                         slice(st, st + bs) for st, bs in zip(starts, block.shape)
                     )

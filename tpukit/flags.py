@@ -53,7 +53,22 @@ class TrainFlags:
     # barrier at the next save/exit. Same formats, same atomic-publish
     # durability; only the loop no longer stalls on disk.
     async_checkpoint: bool = False
-    resume: str = ""  # checkpoint path (either format) or "latest"
+    # Retention (round 13): after each successful checkpoint publish, prune
+    # published checkpoints older than the newest K, so long elastic runs
+    # don't exhaust disk. Quarantined timelines and the newest
+    # integrity-verified (`latest_good`) checkpoint are never pruned.
+    # 0 = keep everything (the pre-round-13 behavior). Note K also bounds
+    # how far back `--on_anomaly rollback` can reach.
+    keep_checkpoints: int = 0
+    # Resume path (either format) or "latest". Round 13: `--resume` is
+    # ELASTIC — when the checkpoint's recorded world (nprocs, device
+    # count, strategy, mesh axes; written into every save's meta sidecar)
+    # differs from the current run's, the state is resharded onto the
+    # current `state_sharding` specs (tpukit/reshard.py) instead of
+    # failing or silently misloading, and a kind="resize" JSONL record
+    # names the change. Hold global batch (batch_size x data shards)
+    # constant across a resize for loss-trajectory parity.
+    resume: str = ""
     # Host input pipeline depth (round 7): a background thread runs
     # prepare_batch + the strategy's host transform + global-batch H2D
     # assembly this many batches ahead, overlapping the in-flight compiled
@@ -239,6 +254,9 @@ def build_parser(
         default=defaults.checkpoint_format,
     )
     parser.add_argument("--async_checkpoint", action="store_true")
+    parser.add_argument(
+        "--keep_checkpoints", type=int, default=defaults.keep_checkpoints
+    )
     parser.add_argument("--resume", type=str, default=defaults.resume)
     parser.add_argument("--prefetch", type=int, default=defaults.prefetch)
     parser.add_argument(

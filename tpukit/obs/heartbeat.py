@@ -134,7 +134,18 @@ class Heartbeat:
         if self._cadence:
             effective = max(effective, 3.0 * self._cadence)
         beats = self.read_all()
-        max_step = max((r.get("step", 0) for r in beats.values()), default=0)
+        # Fleet max over THIS world's ranks only (same stale-beat guard as
+        # check_divergence, same scoping): after an elastic shrink, a
+        # vanished rank's beat that lands post-sweep must not set a step
+        # frontier the live world is then "lagging" behind every window.
+        max_step = max(
+            (
+                r.get("step", 0) for r in beats.values()
+                if self.process_count == 1
+                or int(r.get("process", -1)) < self.process_count
+            ),
+            default=0,
+        )
         out = []
         for proc in range(self.process_count):
             rec = beats.get(proc)
@@ -172,6 +183,20 @@ class Heartbeat:
         """
         by_key: dict[tuple[int, int], dict[str, list[int]]] = {}
         for rec in self.read_all().values():
+            if (
+                self.process_count > 1
+                and int(rec.get("process", -1)) >= self.process_count
+            ):
+                # a beat from a rank beyond THIS world: a stale file from a
+                # larger previous incarnation (elastic resize). The resize
+                # path sweeps these (tpukit/reshard.sweep_stale_world), but
+                # an NFS-delayed write can land after the sweep — never
+                # compare another world's checksums against this one's.
+                # Scoped to real multi-process worlds: a 1-process reader
+                # has no peers of its own, and the single-process harness
+                # pattern (tests plant a fake peer's beat to exercise this
+                # comparison) must keep working.
+                continue
             cs, st = rec.get("checksum"), rec.get("checksum_step")
             if cs is None or st is None:
                 continue

@@ -22,8 +22,12 @@ in README): a training process exits
 
 75 is EX_TEMPFAIL — the sysexits meaning ("temporary failure, retry
 later") matches exactly: the babysitter/scheduler should reschedule with
-`--resume latest`. 76/77 mean "do NOT blindly restart: a human or a
-triage bot should read the bundle first".
+`--resume latest`. Round 13: the relaunch need NOT be the world that
+exited — `--resume` is elastic (tpukit/reshard.py), so a scheduler that
+can only get half the capacity back reshards the checkpoint onto it
+instead of queueing for the original shape (docs/DESIGN.md §12). 76/77
+mean "do NOT blindly restart: a human or a triage bot should read the
+bundle first".
 
 **Preemption** (`PreemptionGuard`): SIGTERM/SIGINT set a flag from the
 signal handler (nothing else is async-signal-safe); the training loop

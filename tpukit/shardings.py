@@ -252,6 +252,14 @@ class Strategy:
         return P()
 
     def state_sharding(self, state_shapes):
+        """The train state's placement on this strategy's mesh. Besides
+        feeding the jitted step's in/out shardings, this tree is the
+        TARGET spec of an elastic restore (tpukit/reshard.py): a
+        checkpoint saved under ANY strategy/world reshards onto whatever
+        this returns for the current mesh — which is why the rules here
+        must be pure functions of (shape, mesh), never of the saving
+        world (FSDP's min_shard_size threshold and divisibility checks
+        re-derive per world for free under that discipline)."""
         return _sharding_tree(self.mesh, self.param_spec, state_shapes)
 
     def batch_sharding(self) -> NamedSharding:

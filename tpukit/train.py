@@ -49,6 +49,7 @@ from tqdm import tqdm
 
 from tpukit import chaos as chaos_lib
 from tpukit import checkpoint as ckpt_lib
+from tpukit import reshard as reshard_lib
 from tpukit import retry as retry_lib
 from tpukit.batching import IGNORE_INDEX, prepare_batch
 from tpukit.cache import enable_compilation_cache
@@ -376,6 +377,11 @@ def fit(
         raise ValueError(f"--max_rollbacks must be >= 0, got {flags.max_rollbacks}")
     if flags.io_retries < 0:
         raise ValueError(f"--io_retries must be >= 0, got {flags.io_retries}")
+    if flags.keep_checkpoints < 0:
+        raise ValueError(
+            f"--keep_checkpoints must be >= 0 (0 keeps everything), got "
+            f"{flags.keep_checkpoints}"
+        )
     if flags.on_anomaly == "rollback" and jax.process_count() > 1 and not flags.heartbeat_dir:
         # the rollback decision is made collective through the heartbeat
         # directory; without it a multi-process world could roll back to
@@ -465,6 +471,7 @@ def _fit_body(
         train_loader, validation_loader = make_loaders(flags, tokenizer, strategy)
         # meter math: a rank-sharded custom loader reports per-host rows
         loader_procs = getattr(train_loader, "num_replicas", 1)
+        global_batch = None  # a custom loader owns its batch geometry
     else:
         train_ds, validation_ds = get_dataset(slice_size=flags.dataset_slice)
         train_ds = transform_dataset(
@@ -527,12 +534,21 @@ def _fit_body(
     # Initialize directly into the sharded layout (no host-side giant pytree).
     state = jax.jit(init_fn, out_shardings=state_sharding)(jax.random.PRNGKey(flags.seed))
 
+    # The world THIS run saves from / resumes into (round 13): every save's
+    # meta sidecar records it, and `--resume` compares it against the
+    # checkpoint's to decide plain-restore vs reshard.
+    run_world = reshard_lib.current_world(strategy, global_batch=global_batch)
+
     # Mid-epoch continuation (round 9): a PREEMPTION save carries resume
     # metadata (epoch + batches consumed); resuming from one continues the
     # interrupted epoch at the exact batch it stopped at — the uninterrupted
     # run's state, bit-exact. Other checkpoints (periodic/final) keep the
     # established semantics: train `--epochs` more epochs from batch 0.
+    # Round 13 makes the restore ELASTIC: a checkpoint whose recorded world
+    # differs from this run's is resharded onto the current state_sharding
+    # specs (tpukit/reshard.py) instead of failing or silently misloading.
     start_epoch, start_skip = 0, 0
+    resize_event = None
     if flags.resume:
         from pathlib import Path
 
@@ -552,22 +568,85 @@ def _fit_body(
                     f"--resume {flags.resume}: checkpoint {resume_path} "
                     f"failed integrity verification ({detail})"
                 )
-        # Both formats restore against the abstract state_shapes (never a
-        # device_get of the live state — that is exactly the gather that
-        # fails for cross-host-sharded state). Sharded checkpoints place
-        # their shards straight into the strategy's shardings; consolidated
-        # ones come back as host arrays and are placed below.
-        restored, was_sharded = ckpt_lib.restore_any(
-            resume_path, state_shapes, state_sharding
-        )
-        state = restored if was_sharded else _place_like(restored, state_sharding)
         meta = ckpt_lib.read_meta(resume_path)
+        saved_w = reshard_lib.saved_world(resume_path)
+        mismatch = reshard_lib.describe_mismatch(saved_w, run_world)
+        if mismatch and meta and meta.get("resize_to") is not None:
+            # resize@N:M chaos contract: the preempt-save named the world
+            # it expects to come back at — a relaunch at a DIFFERENT world
+            # that is not M means the resize path under test was not
+            # exercised; fail loud instead of quietly passing another
+            # scenario. A same-world resume (mismatch is None) stays
+            # legal: that is how a control run reproduces the trajectory.
+            want = int(meta["resize_to"])
+            if want != run_world["device_count"]:
+                raise RuntimeError(
+                    f"--resume {flags.resume}: checkpoint {resume_path} was "
+                    f"preempt-saved by a resize@N:{want} chaos fault "
+                    f"expecting relaunch at {want} devices, but this world "
+                    f"has {run_world['device_count']}"
+                )
+        if mismatch:
+            # Stale-incarnation sweep BEFORE any new-world reader exists:
+            # beat files, rollback decisions and preempt requests from the
+            # old world carry step numbers, checksums and process indices
+            # the resized world must never compare against (a vanished
+            # rank's beat file is never overwritten — without the sweep it
+            # poisons the straggler/divergence checks forever).
+            swept = (
+                reshard_lib.sweep_stale_world(flags.heartbeat_dir)
+                if flags.heartbeat_dir and p0
+                else []
+            )
+            state, rs_info = reshard_lib.reshard_restore(
+                resume_path, state_shapes, state_sharding
+            )
+            resize_event = dict(
+                kind="resize",
+                step=int(jax.device_get(state.step)),
+                checkpoint=str(resume_path),
+                mismatch=mismatch,
+                saved_world=saved_w,
+                world=run_world,
+                swept=swept,
+                **rs_info,
+            )
+        else:
+            # Both formats restore against the abstract state_shapes (never
+            # a device_get of the live state — that is exactly the gather
+            # that fails for cross-host-sharded state). Sharded checkpoints
+            # place their shards straight into the strategy's shardings;
+            # consolidated ones come back as host arrays and are placed
+            # below.
+            restored, was_sharded = ckpt_lib.restore_any(
+                resume_path, state_shapes, state_sharding
+            )
+            state = (
+                restored if was_sharded else _place_like(restored, state_sharding)
+            )
         if meta and meta.get("preempted"):
             start_epoch = int(meta.get("epoch", 0))
             start_skip = int(meta.get("batch_in_epoch", 0))
+            saved_gb = (saved_w or {}).get("global_batch")
+            if start_skip and saved_gb and global_batch and saved_gb != global_batch:
+                import warnings
+
+                warnings.warn(
+                    f"mid-epoch resume across a global-batch change "
+                    f"({saved_gb} -> {global_batch} rows): batch_in_epoch "
+                    f"counts the OLD world's batches, so the stream position "
+                    f"is approximate — hold batch_size x data-shards "
+                    f"constant across a resize for exact continuation",
+                    stacklevel=2,
+                )
         if p0:
             print(
                 f"resumed from {resume_path} at step {int(jax.device_get(state.step))}"
+                + (
+                    f" (resharded: {mismatch})"
+                    if resize_event is not None
+                    else ""
+                )
                 + (
                     f" (preempted mid-epoch: continuing epoch {start_epoch} "
                     f"at batch {start_skip})"
@@ -604,11 +683,40 @@ def _fit_body(
     async_saver = ckpt_lib.AsyncCheckpointer() if flags.async_checkpoint else None
 
     def save_checkpoint(st, meta=None):
+        # Every save records the SAVING world (round 13): the meta sidecar's
+        # `world` entry is what lets a relaunch detect a topology change and
+        # reshard instead of failing — periodic and final saves carry it
+        # too, not just preemption saves, because any checkpoint can be the
+        # one an elastic relaunch resumes from.
+        meta = {**(meta or {}), "world": run_world}
         if async_saver is not None:
             return async_saver.save_auto(
                 st, format=flags.checkpoint_format, meta=meta
             )
         return ckpt_lib.save_auto(st, format=flags.checkpoint_format, meta=meta)
+
+    def prune_checkpoints() -> None:
+        """Retention (--keep_checkpoints K, round 13): after a successful
+        publish, drop published checkpoints older than the newest K.
+        Quarantined timelines and the newest integrity-verified
+        (`latest_good`) candidate are never pruned (checkpoint.py). An
+        in-flight async save is invisible to the scan until its atomic
+        publish — the next prune catches up."""
+        if flags.keep_checkpoints <= 0 or not p0:
+            return
+        # assume_newest_verified: this call always follows OUR OWN publish,
+        # whose writer just computed the checksums — re-hashing it here
+        # every save interval would double per-save disk I/O.
+        removed = ckpt_lib.prune_checkpoints(
+            "checkpoints", keep=flags.keep_checkpoints,
+            assume_newest_verified=True,
+        )
+        if removed:
+            logger.log(
+                kind="ckpt_prune", step=host_step,
+                keep=flags.keep_checkpoints, pruned=removed,
+            )
+            recorder.record("ckpt_prune", step=host_step, pruned=len(removed))
 
     seq = flags.sequence_length - 1  # model sees S-1 after the shift
     meter = MFUMeter(cfg, seq)
@@ -620,6 +728,22 @@ def _fit_body(
     # dumped. The cost is one dict + deque append per step (<1% of any
     # real step; bench.py's obs_overhead record audits it).
     recorder = FlightRecorder()
+    if resize_event is not None:
+        # the elastic restore happened before the logger existed; surface
+        # it now so the JSONL (and tools/report.py) names the topology
+        # change, the reshard cost, and the stale files swept
+        logger.log(**resize_event)
+        recorder.record(
+            "resize", step=resize_event["step"],
+            mismatch=resize_event["mismatch"],
+        )
+        if p0:
+            print(
+                f"elastic resize: {resize_event['mismatch']} "
+                f"({resize_event['format']} reshard, "
+                f"{resize_event['bytes_read']} bytes read in "
+                f"{resize_event['wall_s']:.3f}s)"
+            )
     # Sentinel runs on EVERY process with identical inputs (the window loss
     # is a replicated global mean), so an "abort" decision is collective-
     # consistent — each process checkpoints and raises in lockstep instead
@@ -960,6 +1084,10 @@ def _fit_body(
             "step": host_step, "epoch": ep, "batch_in_epoch": nb,
             "preempted": True, "signal": sig,
         }
+        if chaos_engine is not None and chaos_engine.resize_target is not None:
+            # resize@N:M chaos: record the world this run expects to come
+            # back at, so the relaunch can assert it resharded to M
+            meta["resize_to"] = chaos_engine.resize_target
         with spans.span("checkpoint"):
             path = save_checkpoint(state, meta=meta)
             if async_saver is not None:
@@ -1622,6 +1750,7 @@ def _fit_body(
                             save_checkpoint(state) or checkpoint_path
                         )
                     recorder.record("checkpoint", step=host_step)
+                    prune_checkpoints()
             # Close THIS epoch's prefetcher + bar now (pop_all keeps the
             # fit-lifetime stack from accumulating dead objects across
             # epochs; the stack still covers exceptional unwinds above).
@@ -1741,6 +1870,7 @@ def _fit_body(
         # exit barrier: fit() must not return before the last write is
         # durable (the caller may read or delete the checkpoint next)
         async_saver.wait()
+    prune_checkpoints()
     # Retries/chaos firings since the last window boundary — the epoch tail
     # (validation/generation loader fetches) and the final save above — must
     # reach the JSONL before the logger closes.

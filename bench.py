@@ -29,7 +29,11 @@ per strategy rung (ddp/fsdp/ep): expected+measured bytes-on-the-wire (the
 f32 — the tolerance-gate number. The `elastic_restore` record (round 13,
 ROADMAP #5) measures the reshard-on-restore pass: a sharded FSDP
 checkpoint landing on a half-size world — wall-clock, bytes read, host
-RSS high-water delta, and the byte-parity bit vs a direct restore.
+RSS high-water delta, and the byte-parity bit vs a direct restore. The
+`serving` record (round 14, ROADMAP #1) measures the continuous-batching
+engine (tpukit/serve) against serial per-request cached decode on the
+same seeded synthetic stream: tokens/s (>= 2x is the acceptance bar),
+p50/p99 end-to-end and per-token latency, slot occupancy.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -443,6 +447,119 @@ def bench_elastic_restore(cfg, n_dev):
         shutil.rmtree(ckdir, ignore_errors=True)
 
 
+def bench_serving(cfg, n_dev, requests=32, slots=8, max_new=16):
+    """Continuous batching vs serial per-request `generate` on the SAME
+    seeded synthetic stream (round 14, ROADMAP #1 — the >= 2x bar).
+
+    All sides serve identical requests from identical params: the engine
+    admits into `slots` KV-ring lanes mid-decode (batched bucketed
+    prefills, quantum cached decode steps); the baselines decode one
+    request at a time, each waiting for every request before it — the
+    pre-round-14 serving story. TWO serial baselines are reported so the
+    headline can't hide behind baseline choice:
+
+      - "serial": per-request `generate` AS SHIPPED — its use_cache
+        auto-resolve picks the naive full-re-forward loop at these
+        buffer widths (the v5e-tuned threshold), exactly what serving
+        through the training-era API costs.
+      - "serial_cached": the STRONGEST serial spelling — the fused
+        single-sequence KV-cached while_loop (`use_cache=True`), zero
+        host round-trips per token.
+
+    Each side runs twice (warm-up absorbs compiles — the stream's prompt
+    lengths are drawn from a fixed set so the serial paths' per-length
+    compiles are bounded); the measured run reports tokens/s, end-to-end
+    p50/p99 (arrivals all at t=0, so serial queue wait IS the latency
+    story), per-token p50/p99 and slot occupancy. `speedup` is
+    continuous vs "serial" (the acceptance bar's baseline);
+    `speedup_vs_cached` is the honest harder ratio."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpukit.data import get_tokenizer
+    from tpukit.model import init_params
+    from tpukit.sampling import _decode_loop, _decode_loop_cached
+    from tpukit.serve import ServeConfig, ServeEngine, synthetic_request_stream
+
+    tokenizer = get_tokenizer()
+    tokenizer.pad_token_id = 2
+    cfg = cfg.replace(vocab_size=tokenizer.vocab_size)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    # buckets == the drawn length set: prompts prefill at their exact
+    # length, so the comparison shows scheduling wins, not padding losses
+    buckets = lengths = (8, 16, 24, 32)
+    eos = int(tokenizer.eos_token_id)
+    stream = synthetic_request_stream(
+        tokenizer, requests, seed=0, max_new_tokens=max_new,
+        buckets=buckets, lengths=lengths,
+    )
+    serve = ServeConfig(slots=slots, buckets=buckets, max_new_tokens=max_new,
+                        window_steps=10**9)  # no window records in the bench
+
+    def run_continuous():
+        eng = ServeEngine(params, cfg, serve, eos_id=eos)
+        t0 = time.perf_counter()
+        comps = eng.run(list(stream), max_wall_s=900)
+        wall = time.perf_counter() - t0
+        gen = sum(c.generated for c in comps)
+        e2e = np.asarray([c.e2e_s for c in comps])
+        tok = np.asarray([c.per_token_s for c in comps])
+        s = eng.last_summary
+        return dict(
+            tokens_per_sec=round(gen / wall, 1), wall_s=round(wall, 3),
+            generated_tokens=gen,
+            p50_e2e_s=round(float(np.percentile(e2e, 50)), 4),
+            p99_e2e_s=round(float(np.percentile(e2e, 99)), 4),
+            p50_token_s=round(float(np.percentile(tok, 50)), 5),
+            p99_token_s=round(float(np.percentile(tok, 99)), 5),
+            mean_occupancy=round(s["mean_occupancy"], 3),
+            prefill_s=round(s["prefill_s"], 3),
+            decode_s=round(s["decode_s"], 3),
+        )
+
+    def run_serial(decode_fn):
+        t0 = time.perf_counter()
+        gen, finish = 0, []
+        for r in stream:
+            ids = np.asarray(r.ids, np.int32)
+            buf = np.zeros((1, len(ids) + max_new), np.int32)
+            buf[0, : len(ids)] = ids
+            out, length = decode_fn(
+                params, cfg, jnp.asarray(buf), len(ids), max_new, eos
+            )
+            gen += int(length) - len(ids)
+            finish.append(time.perf_counter() - t0)
+        wall = time.perf_counter() - t0
+        e2e = np.asarray(finish)  # arrivals at t=0: wait-in-line included
+        return dict(
+            tokens_per_sec=round(gen / wall, 1), wall_s=round(wall, 3),
+            generated_tokens=gen,
+            p50_e2e_s=round(float(np.percentile(e2e, 50)), 4),
+            p99_e2e_s=round(float(np.percentile(e2e, 99)), 4),
+        )
+
+    run_continuous()  # warm: bucket prefills + the decode step compile
+    cont = run_continuous()
+    run_serial(_decode_loop)  # warm: one compile per distinct prompt length
+    ser = run_serial(_decode_loop)
+    run_serial(_decode_loop_cached)
+    ser_cached = run_serial(_decode_loop_cached)
+    return {
+        "requests": requests, "slots": slots, "buckets": list(buckets),
+        "max_new_tokens": max_new,
+        "generated_tokens": cont["generated_tokens"],
+        "decode_quantum": serve.decode_quantum,
+        "continuous": cont, "serial": ser, "serial_cached": ser_cached,
+        "speedup": round(cont["tokens_per_sec"] / ser["tokens_per_sec"], 2)
+        if ser["tokens_per_sec"] else None,
+        "speedup_vs_cached": round(
+            cont["tokens_per_sec"] / ser_cached["tokens_per_sec"], 2
+        ) if ser_cached["tokens_per_sec"] else None,
+    }
+
+
 def bench_quant_comm(cfg, n_dev, num_experts=8, steps=8):
     """Quantized-collective ladder (round 12, ROADMAP #2): f32 vs bf16 vs
     int8 `--comm_dtype` on each strategy with hand-wired quantized
@@ -781,6 +898,16 @@ def main(argv=None):
         elastic_restore = {"error": repr(exc)}
         print(f"elastic restore probe failed: {exc!r}", file=sys.stderr)
 
+    # Serving (round 14, ROADMAP #1): continuous batching vs serial
+    # per-request decode on the same seeded stream — tokens/s (the >= 2x
+    # bar), p50/p99 end-to-end + per-token latency, slot occupancy.
+    serving_rec = None
+    try:
+        serving_rec = bench_serving(cfg, n_dev)
+    except Exception as exc:
+        serving_rec = {"error": repr(exc)}
+        print(f"serving probe failed: {exc!r}", file=sys.stderr)
+
     # Host input pipeline (round 7): sync data+h2d share vs the depth-2
     # prefetcher's residual stall share, with loss-parity proof.
     host_pipeline, host_pipeline_err = None, None
@@ -836,6 +963,7 @@ def main(argv=None):
         "moe_dispatch_ladder": moe_dispatch_ladder,
         "quant_comm": quant_comm_rec,
         "elastic_restore": elastic_restore,
+        "serving": serving_rec,
         "host_pipeline": host_pipeline,
         "host_pipeline_error": host_pipeline_err,
         "obs_overhead": obs_overhead,

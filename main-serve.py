@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Recipe 9 (tpukit extension): continuous-batching inference serving.
+
+The extension ladder after the reference's five recipes is 6 = TP
+(main-tp.py), 7 = ring/CP (main-ring.py), 8 = MoE/EP (main-moe.py),
+9 = serving — the "millions of users" half of the north star (ROADMAP #1).
+Everything upstream of this recipe decodes as a training-loop side effect;
+this is the standalone serving path: restore ANY checkpoint the training
+recipes saved (reshard-on-restore handles a different world — round 13),
+shard it over a (data x model) serving mesh with params at their
+TensorParallel training shardings and the per-slot KV ring sharded heads
+over `model` / slots over `data`, and drive a seeded synthetic request
+stream through the continuous-batching engine (tpukit/serve): requests
+admit into free slots mid-decode at bucketed prompt lengths (the whole
+compile budget is the declared bucket set), evict on EOS/length, and the
+`kind="serve"` JSONL windows — tokens/s, p50/p99 per-token and end-to-end
+latency, slot occupancy, prefill/decode wall split — flow through the same
+StepLogger/flight-recorder/report stack that covers training
+(`python tools/report.py serve.jsonl`, with `--min_serve_tps` as the CI
+throughput gate).
+
+Run examples:
+  python main-serve.py --requests 64 --slots 8 --metrics_log serve.jsonl
+  python main-serve.py --checkpoint latest --temperature 0.8 --top_k 40
+  python main-serve.py --checkpoint checkpoints/step-200.msgpack \\
+      --num_experts 8 --moe_dispatch pallas   # dropless MoE: exact cached
+"""
+
+import argparse
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+
+def parse_serve_flags(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    # model shape — must match the checkpoint being served
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--head_dim", type=int, default=32)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--num_layers", type=int, default=8)
+    ap.add_argument("--sequence_length", type=int, default=256,
+                    help="position table size; the KV ring (max bucket + "
+                    "max_new_tokens) must fit inside it")
+    ap.add_argument("--disable_amp", action="store_true")
+    ap.add_argument("--num_experts", type=int, default=0)
+    ap.add_argument("--moe_top_k", type=int, default=1)
+    ap.add_argument("--moe_dispatch", choices=("xla", "pallas"), default="xla",
+                    help="meshless decode dataflow for MoE checkpoints; "
+                    "'pallas' (dropless) makes the cached decode exact")
+    # checkpoint
+    ap.add_argument("--checkpoint", type=str, default="",
+                    help="path or 'latest'; empty serves fresh seeded params "
+                    "(smoke/bench mode)")
+    ap.add_argument("--seed", type=int, default=0)
+    # engine shape
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--buckets", type=str, default="16,32,64",
+                    help="comma-separated prompt-length buckets — the "
+                    "declared compile budget of the serve path")
+    ap.add_argument("--max_new_tokens", type=int, default=20)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top_k", type=int, default=0)
+    ap.add_argument("--window_steps", type=int, default=32)
+    # stream
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--qps", type=float, default=0.0,
+                    help="0 = offered up front (saturation); >0 = seeded "
+                    "exponential arrivals at this rate")
+    # telemetry
+    ap.add_argument("--metrics_log", type=str, default="")
+    ap.add_argument("--compilation_cache_dir", type=str, default="")
+    return ap.parse_args(argv)
+
+
+def pick_serve_grid(n_devices: int, heads: int, slots: int) -> dict:
+    """(data x model) serving grid: the largest model degree <= 4 dividing
+    both the device count and the head count (the KV ring shards heads
+    over `model`; main-tp.py's rule), remaining devices data-parallel —
+    shrunk to the largest divisor of the slot count, since slots shard
+    over `data`."""
+    for model in (4, 2, 1):
+        if n_devices % model == 0 and heads % model == 0:
+            data = n_devices // model
+            while data > 1 and slots % data:
+                data -= 1
+            return {"data": data, "model": model}
+    return {"data": 1, "model": 1}
+
+
+def main(argv=None):
+    flags = parse_serve_flags(argv)
+    import jax
+    import jax.numpy as jnp
+
+    from tpukit import checkpoint as ckpt_lib
+    from tpukit import reshard as reshard_lib
+    from tpukit.data import get_tokenizer
+    from tpukit.mesh import create_mesh, initialize_runtime, is_process_zero
+    from tpukit.model import GPTConfig
+    from tpukit.obs import FlightRecorder, StepLogger
+    from tpukit.serve import ServeConfig, ServeEngine, synthetic_request_stream
+    from tpukit.shardings import DataParallel, SingleDevice, TensorParallel
+    from tpukit.train import TrainState, create_train_state, make_optimizer
+
+    initialize_runtime()
+    if flags.compilation_cache_dir:
+        from tpukit.cache import enable_compilation_cache
+
+        enable_compilation_cache(flags.compilation_cache_dir)
+
+    tokenizer = get_tokenizer()
+    tokenizer.pad_token_id = 2  # every recipe pins pad to 2 (main-single.py:23)
+    cfg = GPTConfig(
+        dim=flags.dim,
+        head_dim=flags.head_dim,
+        heads=flags.heads,
+        num_layers=flags.num_layers,
+        vocab_size=tokenizer.vocab_size,
+        max_position_embeddings=flags.sequence_length,
+        compute_dtype=jnp.float32 if flags.disable_amp else jnp.bfloat16,
+        num_experts=flags.num_experts,
+        router_top_k=flags.moe_top_k,
+        moe_dispatch=flags.moe_dispatch if flags.num_experts > 0 else "xla",
+    )
+    buckets = tuple(sorted({int(b) for b in flags.buckets.split(",") if b}))
+
+    # ---- serving mesh + params at their training shardings ---------------
+    # Dense models serve TensorParallel (heads over `model`); MoE
+    # checkpoints serve replicated over a data-only grid — the Megatron
+    # rules don't cover expert banks, and the meshless MoE decode dataflow
+    # (xla buffers / dropless pallas) needs no expert axis.
+    n_dev = len(jax.devices())
+    if flags.num_experts > 0:
+        data = n_dev
+        while data > 1 and flags.slots % data:
+            data -= 1
+        mesh = create_mesh({"data": data})
+        strategy = DataParallel(mesh) if data > 1 else SingleDevice()
+    else:
+        mesh = create_mesh(pick_serve_grid(n_dev, flags.heads, flags.slots))
+        strategy = TensorParallel(mesh)
+    strategy.validate_config(cfg)
+
+    # Shapes only — serving never steps. The restore below reads the FULL
+    # TrainState (params + both Adam moments, ~3x the params bytes) and
+    # keeps only params: the checkpoint readers restore whole manifests/
+    # blobs against a structure-matched template. A params-only restore
+    # path (skip opt_state leaves at the reader) would cut serve cold-start
+    # I/O and transient memory ~3x — a future round's optimization.
+    optimizer = make_optimizer(1e-4)
+    init_fn = partial(create_train_state, cfg=cfg, optimizer=optimizer,
+                      strategy=strategy)
+    state_shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(flags.seed))
+    state_sharding = strategy.state_sharding(state_shapes)
+
+    logger = StepLogger(flags.metrics_log)
+    recorder = FlightRecorder()
+    p0 = is_process_zero()
+
+    if flags.checkpoint:
+        path = (ckpt_lib.latest_any() if flags.checkpoint == "latest"
+                else flags.checkpoint)
+        if path is None:
+            raise FileNotFoundError("--checkpoint latest: no checkpoint found")
+        ok, detail = ckpt_lib.verify_checkpoint(path)
+        if not ok:
+            raise RuntimeError(f"--checkpoint {path}: failed integrity "
+                               f"verification ({detail})")
+        saved_w = reshard_lib.saved_world(path)
+        run_world = reshard_lib.current_world(strategy)
+        mismatch = reshard_lib.describe_mismatch(saved_w, run_world)
+        if mismatch:
+            # the training world rarely equals the serving grid: round-13
+            # reshard-on-restore lands the saved state directly at the
+            # serving shardings, streaming block-by-block
+            try:
+                state, rs_info = reshard_lib.reshard_restore(
+                    path, state_shapes, state_sharding
+                )
+            except ValueError as exc:
+                raise ValueError(
+                    f"--checkpoint {path}: state structure does not match "
+                    f"the model flags (--dim/--heads/--num_layers/"
+                    f"--num_experts... must equal the training run's). "
+                    f"Original error: {exc}"
+                ) from exc
+            rec = dict(kind="resize", mismatch=mismatch,
+                       checkpoint=str(path), world=run_world, **rs_info)
+            logger.log(**rec)
+            recorder.record("resize", mismatch=mismatch)
+            if p0:
+                print(f"resharded for serving: {mismatch}")
+        else:
+            try:
+                state, _ = ckpt_lib.restore_any(path, state_shapes, state_sharding)
+            except ValueError as exc:
+                # flax's structure mismatch is deep and unnamed — say what
+                # it almost always means at this surface
+                raise ValueError(
+                    f"--checkpoint {path}: state structure does not match "
+                    f"the model flags (--dim/--heads/--num_layers/"
+                    f"--num_experts... must equal the training run's). "
+                    f"Original error: {exc}"
+                ) from exc
+        params = state.params
+        if p0:
+            print(f"serving checkpoint {path} (step "
+                  f"{int(jax.device_get(state.step))})")
+        del state
+    else:
+        # smoke/bench mode: fresh seeded params directly at the shardings
+        params = jax.jit(
+            lambda r: init_fn(r).params, out_shardings=state_sharding.params
+        )(jax.random.PRNGKey(flags.seed))
+        if p0:
+            print("serving fresh seeded params (no --checkpoint)")
+
+    # ---- the engine + the stream -----------------------------------------
+    serve = ServeConfig(
+        slots=flags.slots, buckets=buckets,
+        max_new_tokens=flags.max_new_tokens,
+        temperature=flags.temperature, top_k=flags.top_k,
+        window_steps=flags.window_steps,
+    )
+    engine = ServeEngine(params, cfg, serve, eos_id=int(tokenizer.eos_token_id),
+                         mesh=mesh, logger=logger, recorder=recorder)
+    requests = synthetic_request_stream(
+        tokenizer, flags.requests, seed=flags.seed,
+        max_new_tokens=flags.max_new_tokens, buckets=buckets, qps=flags.qps,
+    )
+    t0 = time.perf_counter()
+    completions = engine.run(requests)
+    wall = time.perf_counter() - t0
+
+    if p0:
+        gen = sum(c.generated for c in completions)
+        e2e = sorted(c.e2e_s for c in completions)
+        occ = (engine.last_summary or {}).get("mean_occupancy") or 0.0
+        print(f"served {len(completions)} requests / {gen} tokens in "
+              f"{wall:.2f}s ({gen / wall:.1f} tokens/s, occupancy "
+              f"{100 * occ:.0f}%)")
+        if e2e:
+            print(f"e2e latency p50 {1e3 * e2e[len(e2e) // 2]:.1f} ms  "
+                  f"p99 {1e3 * e2e[min(len(e2e) - 1, int(len(e2e) * 0.99))]:.1f} ms")
+        for c in completions[:3]:
+            print(f"  [{c.rid}] " + tokenizer.decode(
+                np.asarray(c.ids), skip_special_tokens=True))
+        if flags.metrics_log:
+            print(f"serve telemetry -> {flags.metrics_log} "
+                  f"(render: python tools/report.py {flags.metrics_log})")
+    logger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    from tpukit.recovery import run_recipe
+
+    # Exit-code contract (docs/DESIGN.md "recovery", README): 0 clean,
+    # 75 preempted-and-checkpointed, 76 anomaly abort, 77 rollback budget
+    # exhausted — what a babysitter script keys its relaunch decision on.
+    sys.exit(run_recipe(main))

@@ -29,10 +29,17 @@ exact on CPU too) and renders bench.py's `quant_comm` record with the
 bytes-on-the-wire headline. Round-13 elastic resize adds "resize"
 (reshard-on-restore: the topology change, bytes read, stale files swept)
 and "ckpt_prune" (--keep_checkpoints retention) to the recovery section,
-plus bench.py's `elastic_restore` record. This tool needs NOTHING but
+plus bench.py's `elastic_restore` record. Round-14 serving adds "serve"
+(per-window continuous-batching telemetry: tokens/s, slot occupancy,
+admit/evict counts, prefill/decode/sync wall split, latency percentiles)
+and "serve_summary" (whole-run serving headline) rendered as a
+"== serving ==" section, bench.py's `serving` record (continuous
+batching vs serial per-request decode on the same stream), and the
+`--min_serve_tps` CI gate. This tool needs NOTHING but
 the file — no jax import, so it runs anywhere the log was copied to.
 
 Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
+                                        [--min_serve_tps 100]
 """
 
 from __future__ import annotations
@@ -354,6 +361,39 @@ def summarize(records: list[dict]) -> str:
             if r.get("dir"):
                 line += f" -> {r['dir']}"
             w(line)
+    # round-14 serving (tpukit/serve): per-window continuous-batching
+    # telemetry + the whole-run summary. Rendered for both a recipe-9
+    # --metrics_log and any log a ServeEngine wrote into.
+    serve_wins = _rows(records, "serve")
+    serve_sums = _rows(records, "serve_summary")
+    if serve_wins or serve_sums:
+        w("== serving ==")
+    for r in serve_sums:
+        w(f"  {r.get('requests', '?')} requests over {r.get('slots', '?')} "
+          f"slots (buckets {r.get('buckets', '?')}, used "
+          f"{r.get('buckets_used', '?')}): "
+          f"{human_count(r.get('tokens_per_sec'))} tokens/s  "
+          f"occupancy {100 * (r.get('mean_occupancy') or 0):.0f}%")
+        p50e, p99e = r.get("p50_e2e_s"), r.get("p99_e2e_s")
+        p50t, p99t = r.get("p50_token_s"), r.get("p99_token_s")
+        if p50e is not None:
+            w(f"  latency e2e p50/p99: {p50e * 1e3:.1f}/{p99e * 1e3:.1f} ms   "
+              f"per-token p50/p99: {p50t * 1e3:.2f}/{p99t * 1e3:.2f} ms")
+        w(f"  {r.get('generated_tokens', '?')} tokens in "
+          f"{r.get('decode_steps', '?')} decode steps over "
+          f"{r.get('wall_s', 0):.2f}s  (prefill {r.get('prefill_s', 0):.2f}s"
+          f" / decode {r.get('decode_s', 0):.2f}s"
+          f" / sync {r.get('sync_s', 0):.2f}s)   evicted: "
+          f"{r.get('evicted_eos', 0)} eos, {r.get('evicted_length', 0)} length")
+    if serve_wins:
+        occ = [r["occupancy"] for r in serve_wins if r.get("occupancy") is not None]
+        tps = [r["tokens_per_sec"] for r in serve_wins if r.get("tokens_per_sec")]
+        w(f"  {len(serve_wins)} serve windows: occupancy mean "
+          f"{100 * sum(occ) / len(occ):.0f}%"
+          + (f"   tokens/s last {human_count(tps[-1])} best "
+             f"{human_count(max(tps))}" if tps else "")
+          + f"   queue depth last {serve_wins[-1].get('queue_depth', '?')}")
+
     cache_rows = _rows(records, "compile_cache")
     if cache_rows:
         w("== compile cache ==")
@@ -441,6 +481,42 @@ def summarize(records: list[dict]) -> str:
              f"{human_bytes(overhead)})" if overhead is not None else "")
           + "   parity vs direct restore: "
           + ("OK" if er.get("parity_ok") else "<- MISMATCH"))
+    # round-14 serving bench (ROADMAP #1): continuous batching vs serial
+    # per-request decode on the SAME seeded synthetic stream — the >= 2x
+    # tokens/s headline plus the latency/occupancy numbers a capacity
+    # planner reads.
+    for r in records:
+        sv = r.get("serving")
+        if not isinstance(sv, dict):
+            continue
+        w("== serving (bench, continuous vs serial) ==")
+        if "error" in sv:
+            w(f"  ERROR {sv['error']}")
+            continue
+        w(f"  stream: {sv.get('requests', '?')} requests, "
+          f"{sv.get('generated_tokens', '?')} generated tokens, "
+          f"{sv.get('slots', '?')} slots, buckets {sv.get('buckets', '?')}")
+        rows = (("continuous", sv.get("continuous")),
+                ("serial", sv.get("serial")),
+                ("serial_cached", sv.get("serial_cached")))
+        for name, row in rows:
+            if not row:
+                continue
+            p50, p99 = row.get("p50_e2e_s"), row.get("p99_e2e_s")
+            w(f"  {name:<14} {human_count(row.get('tokens_per_sec'))} tokens/s"
+              + (f"   e2e p50/p99 {p50 * 1e3:.1f}/{p99 * 1e3:.1f} ms"
+                 if p50 is not None else "")
+              + (f"   occupancy {100 * row['mean_occupancy']:.0f}%"
+                 if row.get("mean_occupancy") is not None else ""))
+        sp = sv.get("speedup")
+        if sp is not None:
+            w(f"  headline: continuous batching {sp:.2f}x serial "
+              f"per-request generate on the same stream"
+              + ("" if sp >= 2.0 else "  <- BELOW the 2x acceptance bar"))
+        spc = sv.get("speedup_vs_cached")
+        if spc is not None:
+            w(f"  vs the strongest serial baseline (forced cached "
+              f"while_loop): {spc:.2f}x")
     # round-11 dispatch ladder (ROADMAP #3): the three MoE dataflows side
     # by side at e8 top-1/top-2, MFU normalized by ACTIVE FLOPs (top_k
     # experts + router per token) so padding/dispatch waste reads as lost
@@ -480,6 +556,23 @@ def check_min_goodput(records: list[dict], threshold: float) -> tuple[bool, str]
     )
 
 
+def check_min_serve_tps(records: list[dict], threshold: float) -> tuple[bool, str]:
+    """Serving-throughput CI gate (`--min_serve_tps`): the run's
+    `kind="serve_summary"` tokens/s must reach `threshold`. Returns
+    (ok, message) — missing summary fails, a serving regression must not
+    hide behind an empty log."""
+    sums = [r for r in _rows(records, "serve_summary")
+            if r.get("tokens_per_sec") is not None]
+    if not sums:
+        return False, "--min_serve_tps: no serve_summary record in the log"
+    tps = sums[-1]["tokens_per_sec"]
+    verdict = "OK" if tps >= threshold else "FAIL"
+    return tps >= threshold, (
+        f"--min_serve_tps {verdict}: {tps:.1f} tokens/s "
+        f"(threshold {threshold:.1f})"
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("log", help="metrics JSONL written via --metrics_log")
@@ -488,18 +581,27 @@ def main(argv=None) -> int:
         help="assert mean train-window goodput >= FRACTION (exit 2 below "
         "it) — a cheap perf regression gate for CI",
     )
+    ap.add_argument(
+        "--min_serve_tps", type=float, default=None, metavar="TOKENS_PER_SEC",
+        help="assert the serve_summary tokens/s >= this (exit 2 below it) "
+        "— the serving-throughput regression gate for CI",
+    )
     args = ap.parse_args(argv)
     records = load(args.log)
     if not records:
         print(f"{args.log}: no records", file=sys.stderr)
         return 1
     print(summarize(records))
+    rc = 0
     if args.min_goodput is not None:
         ok, msg = check_min_goodput(records, args.min_goodput)
         print(msg, file=sys.stdout if ok else sys.stderr)
-        if not ok:
-            return 2
-    return 0
+        rc = rc if ok else 2
+    if args.min_serve_tps is not None:
+        ok, msg = check_min_serve_tps(records, args.min_serve_tps)
+        print(msg, file=sys.stdout if ok else sys.stderr)
+        rc = rc if ok else 2
+    return rc
 
 
 if __name__ == "__main__":

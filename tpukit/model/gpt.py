@@ -344,7 +344,14 @@ def _apply_moe_ffn(layer, cfg: GPTConfig, x, rng, deterministic, pad_mask=None):
     E * sum(frac_tokens_e * mean_router_prob_e), averaged over rows — 1.0
     at perfect balance. The KV-cached decode routes each chunk with its
     own capacity window, so a capacity-dropped token can differ from the
-    full-reforward path there — use_cache=False is exact.
+    full-reforward path there — use_cache=False is exact for the buffer
+    dispatches. EXCEPTION (round 14): with moe_dispatch="pallas" and no
+    moe_capacity override the dataflow is DROPLESS — every routed token
+    computes regardless of chunk composition, per-token routing depends
+    only on that token's activations, and the cached decode is therefore
+    exactly the full-reforward decode (cached==uncached equivalence in
+    tests/test_serve.py); sampling's use_cache auto-resolve treats that
+    case as exact (tpukit/sampling._cached_decode_exact).
 
     The dispatch DATAFLOW is pluggable (cfg.moe_dispatch, implementations
     in tpukit/ops/moe_dispatch.py and tpukit/ops/moe_gemm.py): "xla"
@@ -529,7 +536,15 @@ def _apply_attention_cached(layer, cfg: GPTConfig, x, k_cache, v_cache, start):
     """Attention for decode: write this chunk's K/V into the cache at
     `start` and attend over all cached positions `<= query position`.
     x: [B, T, dim]; k_cache/v_cache: [B, heads, S_max, d]. Returns
-    (out, k_cache, v_cache)."""
+    (out, k_cache, v_cache).
+
+    `start` is a scalar (every row writes at the same offset — the
+    single-sequence decode and the full-width batched prefill) or a
+    `[B]` vector of PER-ROW offsets (the continuous-batching decode
+    step, tpukit/serve: each slot sits at its own cursor). The scalar
+    path keeps its original dynamic-update-slice trace byte-unchanged;
+    the vector path vmaps the cache write over rows and offsets each
+    row's query position independently — identical math per row."""
     batch, t = x.shape[0], x.shape[1]
     q = linear(x, layer["attn"]["q"], cfg.compute_dtype)
     k = linear(x, layer["attn"]["k"], cfg.compute_dtype)
@@ -537,13 +552,19 @@ def _apply_attention_cached(layer, cfg: GPTConfig, x, k_cache, v_cache, start):
     split = lambda z: z.reshape(batch, t, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
     q, k, v = split(q), split(k), split(v)
 
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, start, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, start, 0))
-
     s_max = k_cache.shape[2]
+    if jnp.ndim(start) == 1:
+        upd = lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (0, s, 0))
+        k_cache = jax.vmap(upd)(k_cache, k, start)
+        v_cache = jax.vmap(upd)(v_cache, v, start)
+        q_pos = (start[:, None] + jnp.arange(t))[:, None, :, None]
+    else:
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, start, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, start, 0))
+        q_pos = (start + jnp.arange(t))[None, None, :, None]
+
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * (1.0 / cfg.head_dim**0.5)
     key_pos = jnp.arange(s_max)[None, None, None, :]
-    q_pos = (start + jnp.arange(t))[None, None, :, None]
     scores = jnp.where(key_pos <= q_pos, scores, jnp.asarray(-1e9, scores.dtype))
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(v_cache.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache)
@@ -555,7 +576,10 @@ def _apply_attention_cached(layer, cfg: GPTConfig, x, k_cache, v_cache, start):
 def forward_cached(params: Params, cfg: GPTConfig, input_ids, position_ids, cache, start):
     """Forward a chunk of tokens with the KV cache: writes K/V for positions
     `[start, start+T)` and returns `(logits [B, T, padded_vocab], cache)`.
-    Prefill with the prompt chunk, then decode with T=1 per step."""
+    Prefill with the prompt chunk, then decode with T=1 per step. `start`
+    is a scalar offset shared by every row, or a `[B]` vector of per-row
+    offsets (the continuous-batching decode step — see
+    `_apply_attention_cached`)."""
     x = apply_embeddings(params, cfg, input_ids, position_ids)
     new_k, new_v = [], []
     for i in range(cfg.num_layers):

@@ -1,0 +1,21 @@
+"""tpukit.serve — continuous-batching inference engine (round 14, ROADMAP #1).
+
+Device programs (batched KV-cached decode, per-bucket prefill, the fused
+whole-batch loop, the TP comm audit) in `decode.py`; the host-side slot
+scheduler, request/completion types, serving telemetry and the synthetic
+stream in `engine.py`. Recipe: `main-serve.py`.
+"""
+
+from tpukit.serve.decode import (  # noqa: F401
+    decode_loop,
+    decode_step,
+    decode_step_comm,
+    prefill_slots,
+)
+from tpukit.serve.engine import (  # noqa: F401
+    Completion,
+    Request,
+    ServeConfig,
+    ServeEngine,
+    synthetic_request_stream,
+)

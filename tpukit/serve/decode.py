@@ -1,0 +1,328 @@
+"""Batched KV-cached decode primitives for the serving engine.
+
+Round 14 (ROADMAP #1): the device half of `tpukit/serve`. Three jitted
+programs generalize the single-sequence cached decode of
+`tpukit/sampling.py` from batch=1 to `[N_slots, W]` with PER-SLOT state —
+cursors, EOS/limit flags, rng keys — over one preallocated per-slot KV
+ring (`gpt.init_kv_cache(cfg, slots, max_len)`):
+
+  - `prefill_slots`: write an admit-batch of (bucket-padded) prompts
+    into their slots' token-buffer rows and K/V ring rows in ONE
+    dispatch — the "prefill" phase of phase-separated serving, batched
+    so a burst of arrivals costs one forward instead of one per
+    request. Bucket length and admit size are static (via the rows'
+    shape), so the serve path compiles one program per (bucket,
+    power-of-two admit size) pair — the declared compile budget
+    (`ServeConfig.compile_budget`).
+  - `decode_step`: ONE token for every slot — each slot forwards the
+    token at its own cursor (a per-row `start` vector through
+    `gpt.forward_cached`), samples with its own key fold, and appends
+    unless it hit EOS or its length limit. One compile total, any slot
+    occupancy. The "decode" phase; the host scheduler interleaves
+    prefills between steps without ever stalling active slots.
+  - `decode_loop`: the fused whole-batch variant (full-width prefill +
+    a `lax.while_loop` of the same step body) for callers that know the
+    whole batch up front — `sampling.generate_batch` and the per-epoch
+    `train.generate_samples` ride this, replacing the retired O(S^2)
+    re-forward loop (`_decode_loop_batch`, rounds 4-13).
+
+Why stale cache garbage is harmless (the invariant every program here
+leans on): attention masks keys at positions > the query position, and a
+slot's decode writes its K/V at `cursor-1` BEFORE attending — so the
+attended range `[0, cursor-1]` is always exactly the positions the
+CURRENT request has written (prefill covers `[0, bucket)`, decode
+rewrites from `prompt_len-1` contiguously). Leftovers from a longer
+evicted request above the cursor are never read, which is what lets a
+freed slot be reused with nothing but a prefill — no cache clearing,
+no masked writes in the hot step.
+
+Token parity: per slot, the math is exactly `sampling._decode_loop_cached`
+— same read/write order, same `fold_in(key, cursor)` sampling fold, same
+stop-before-EOS append — so the batched decode is token-for-token the
+serial cached decode whatever the surrounding slots do
+(tests/test_serve.py, incl. mid-stream admit/evict).
+
+Sharded serving (`mesh`): the step runs under the training TP mesh with
+params at their training shardings, the KV ring sharded over heads on
+the `model` axis and slots on the `data` axis. The one deliberate
+sharding constraint pins the step's sampled logits to model-replicated —
+one all-gather per step at a known size — so the per-step collectives
+have a closed form (`decode_step_comm`) the compiled HLO must match
+(the round-10/12 audit discipline, tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from tpukit.model import gpt
+
+
+def _select_next(last, cursors, keys, temperature: float, top_k: int):
+    """Next token per slot from f32 logits `last [N, V]`: exactly
+    `sampling._sample_next` — THE one sampling spelling every decode
+    loop shares — vmapped over slots. vmap semantics make each row's
+    draw identical to the unbatched call, which is what the same-seed
+    batched==serial parity tests pin; temperature == 0 is the greedy
+    static branch (keys untouched)."""
+    from tpukit.sampling import _sample_next
+
+    if temperature > 0.0:
+        return jax.vmap(
+            partial(_sample_next, temperature=temperature, top_k=top_k)
+        )(last, cursors, keys)
+    return jnp.argmax(last, axis=-1)
+
+
+def _advance(params, cfg, buf, cache, cursors, active, limits, keys,
+             eos_id: int, temperature: float, top_k: int, mesh=None):
+    """One decode tick for every slot (shared by `decode_step` and
+    `decode_loop`'s while body). Inactive slots re-forward their last
+    token into the same cache position — a write of identical values —
+    and are masked out of every buffer/cursor update."""
+    n, total = buf.shape
+    read = jnp.clip(cursors - 1, 0, total - 1)
+    tok = jnp.take_along_axis(buf, read[:, None], axis=1)
+    logits, cache = gpt.forward_cached(
+        params, cfg, tok, read[:, None].astype(jnp.int32), cache, read
+    )
+    last = logits[:, -1].astype(jnp.float32)
+    if mesh is not None and "model" in mesh.axis_names:
+        # Pin the sampled logits model-replicated (slots stay data-sharded):
+        # ONE all-gather of the vocab-sharded head output per step, at a
+        # size the closed-form audit (`decode_step_comm`) prices exactly.
+        # Left to itself GSPMD picks its own (version-dependent) plan for
+        # the argmax/categorical over a sharded vocab axis — unauditable.
+        batch_axis = "data" if "data" in mesh.axis_names else None
+        last = jax.lax.with_sharding_constraint(
+            last, NamedSharding(mesh, P(batch_axis, None))
+        )
+    next_token = _select_next(last, cursors, keys, temperature, top_k).astype(buf.dtype)
+    hit_eos = next_token == eos_id
+    fits = cursors < limits
+    # stop BEFORE appending on EOS (reference utils.py:67-68)
+    append = active & fits & ~hit_eos
+    write = jnp.clip(cursors, 0, total - 1)
+    # One-hot select instead of a scatter: `buf.at[rows, write].set` makes
+    # GSPMD partition a batched scatter, which drags its s32 index tensors
+    # through collective-permute/all-gather plumbing on the data axis —
+    # unauditable noise for a [N, W] buffer a fused elementwise select
+    # writes with ZERO comm. Values are identical.
+    col = jax.lax.broadcasted_iota(jnp.int32, (n, total), 1)
+    hit = (col == write[:, None]) & append[:, None]
+    buf = jnp.where(hit, next_token[:, None], buf)
+    cursors = jnp.where(append, cursors + 1, cursors)
+    active = active & fits & ~hit_eos & (cursors < limits)
+    return buf, cache, cursors, active
+
+
+# NOTE (container jaxlib 0.4.37): buffer donation is deliberately OMITTED
+# on the serve programs. Donated executables DESERIALIZED from the
+# persistent compilation cache mis-alias their inputs on this jaxlib —
+# reproduced deterministically: a fresh process with a warm cache decodes
+# garbage (slots with 0 or limit-overrunning generated counts) while the
+# compiling process is correct, and stripping donate_argnames fixes the
+# round-trip with no other change. The KV ring at test/bench scale copies
+# cheaply; re-add donation when the container jaxlib moves past the bug.
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "eos_id", "temperature", "top_k", "mesh", "steps"),
+)
+def decode_step(params, cfg: gpt.GPTConfig, buf, cache, cursors, active,
+                limits, keys, eos_id: int, temperature: float = 0.0,
+                top_k: int = 0, mesh=None, steps: int = 1):
+    """`steps` tokens for every slot (default 1). buf `[N, W]`, cache the
+    `init_kv_cache` ring, cursors/active/limits `[N]`, keys `[N, 2]`
+    uint32 (per-slot PRNG keys — ignored by the greedy trace). Returns
+    the advanced `(buf, cache, cursors, active)`; a slot leaves `active`
+    when it samples EOS or its cursor reaches its limit, and a slot that
+    finishes mid-quantum stays FROZEN for the remaining ticks — the
+    token stream is identical for any `steps`, only the host sync
+    cadence changes. ONE compile per quantum size for the whole serve
+    path regardless of occupancy or prompt mix.
+
+    `steps > 1` is the decode QUANTUM: one runtime dispatch (and one
+    host sync) per `steps` tokens instead of per token. Measured on the
+    CPU backend a standalone dispatch costs ~5ms of host/runtime
+    overhead per call while a loop-body tick costs ~1ms — per-token
+    dispatch is exactly how the serial while_loop decode out-runs a
+    naively-scheduled batched engine. The cost is eviction/admission
+    latency quantized to `steps` ticks. The comm audit is unaffected:
+    the fori_loop body appears ONCE in the compiled HLO, so
+    `decode_step_comm` stays the per-step expectation at any quantum
+    (tests/test_serve.py pins this)."""
+    if steps == 1:
+        return _advance(params, cfg, buf, cache, cursors, active, limits,
+                        keys, eos_id, temperature, top_k, mesh)
+
+    def tick(_, carry):
+        buf, cache, cursors, active = carry
+        return _advance(params, cfg, buf, cache, cursors, active, limits,
+                        keys, eos_id, temperature, top_k, mesh)
+
+    return jax.lax.fori_loop(0, steps, tick, (buf, cache, cursors, active))
+
+
+# No donation here either — see the decode_step note (persistent-cache
+# deserialization of donated executables mis-aliases on this jaxlib).
+@partial(
+    jax.jit,
+    static_argnames=("cfg",),
+)
+def prefill_slots(params, cfg: gpt.GPTConfig, buf, cache, cursors, active,
+                  limits, keys, slots, rows, prompt_lens, new_limits, new_keys):
+    """Admit `A` requests in ONE dispatch: write their bucket-padded
+    prompts `rows [A, bucket]` into the token buffer at `slots [A]` and
+    prefill their K/V for positions `[0, bucket)` as ONE batched forward
+    (pad positions write garbage K/V that the decode step's causal window
+    never reads — module docstring). The admit-batch size A and the
+    bucket are STATIC (rows' shape): compile count == distinct
+    (bucket, A) pairs, which the engine bounds by padding A to a power
+    of two with REPEATS of the first entry — a repeated admit rewrites
+    the same slot with the same values, so dummies are idempotent.
+    `slots`/`prompt_lens`/`new_limits`/`new_keys` are traced, so any
+    request mix at any lanes reuses the pair's program.
+
+    The prefill forward only materializes a `[A, bucket]`-deep scratch
+    cache (the positions it writes); each admitted slot's scratch rows
+    land in the big ring at `[slot, :, 0:bucket)`. Only the admitted
+    lanes' state changes — active slots pass through untouched, which is
+    what lets the scheduler admit mid-decode without stalling anyone."""
+    a, bucket = rows.shape
+    pos = jnp.broadcast_to(jnp.arange(bucket, dtype=jnp.int32), rows.shape)
+    scratch = gpt.init_kv_cache(cfg, a, bucket)
+    _, scratch = gpt.forward_cached(params, cfg, rows, pos, scratch, 0)
+    for i in range(a):  # A is static and small (<= slots): unrolled writes
+        buf = jax.lax.dynamic_update_slice(
+            buf, rows[i : i + 1].astype(buf.dtype), (slots[i], 0)
+        )
+        cache = {
+            n: jax.lax.dynamic_update_slice(
+                c,
+                jax.lax.dynamic_slice_in_dim(scratch[n], i, 1, axis=1),
+                (0, slots[i], 0, 0, 0),
+            )
+            for n, c in cache.items()
+        }
+        cursors = cursors.at[slots[i]].set(prompt_lens[i])
+        active = active.at[slots[i]].set(True)
+        limits = limits.at[slots[i]].set(new_limits[i])
+        keys = keys.at[slots[i]].set(new_keys[i])
+    return buf, cache, cursors, active, limits, keys
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "eos_id", "temperature", "top_k"),
+)
+def decode_loop(params, cfg: gpt.GPTConfig, buf, prompt_lens,
+                max_new_tokens: int, eos_id: int, temperature: float = 0.0,
+                top_k: int = 0, rng=None):
+    """Fused whole-batch cached decode: prefill the full `[N, W]` buffer
+    once (per-row prompt lengths are TRACED — one compile per buffer
+    shape), then run the decode tick in a `lax.while_loop` until every
+    row is done. Zero host round-trips inside the loop — the right shape
+    when the whole batch is known up front (`sampling.generate_batch`).
+    Returns `(buf, lengths)`.
+
+    All rows share `rng` (each folds its own cursor), matching serial
+    `generate(..., seed=)` per prompt. Token-for-token equal to the
+    serial cached decode for every row; see the module docstring for why
+    the full-width prefill's pad-position K/V garbage is never read."""
+    n, total = buf.shape
+    cache = gpt.init_kv_cache(cfg, n, total)
+    pos = jnp.broadcast_to(jnp.arange(total, dtype=jnp.int32), buf.shape)
+    _, cache = gpt.forward_cached(params, cfg, buf, pos, cache, 0)
+    cursors = prompt_lens.astype(jnp.int32)
+    limits = jnp.minimum(cursors + max_new_tokens, total)
+    active = cursors < limits
+    keys = (
+        jnp.broadcast_to(rng, (n,) + rng.shape)
+        if rng is not None
+        else jnp.zeros((n, 2), jnp.uint32)
+    )
+
+    def cond(carry):
+        return jnp.any(carry[3])
+
+    def body(carry):
+        buf, cache, cursors, active = carry
+        return _advance(params, cfg, buf, cache, cursors, active, limits,
+                        keys, eos_id, temperature, top_k)
+
+    buf, _, cursors, _ = jax.lax.while_loop(
+        cond, body, (buf, cache, cursors, active)
+    )
+    return buf, cursors
+
+
+def decode_step_comm(cfg: gpt.GPTConfig, mesh, slots: int, top_k: int = 0) -> dict:
+    """Closed-form PER-DEVICE collective expectation for one compiled
+    `decode_step` under a (data x model) serving mesh — the round-10/12
+    audit discipline applied to the decode path: the compiled HLO's
+    collectives must match this exactly (tests/test_serve.py).
+
+    With params at their TensorParallel training shardings, slots (and
+    the KV ring's batch axis) sharded over `data` and heads over
+    `model`, the step's comm is:
+
+      - `all-reduce` x (2*num_layers + 1): the Megatron pair per layer
+        (row-parallel attn-out + ffn-down partial sums) on the
+        `[N/d, 1, dim]` activations in the compute dtype, plus ONE
+        f32 all-reduce for the token-embedding gather from the
+        row(vocab)-sharded table (GSPMD's partial-gather lowering:
+        local masked take + psum).
+      - `all-gather` x 1: the deliberate logits constraint in
+        `_advance` — the vocab-sharded head output gathered
+        model-replicated before sampling, `[N/d, padded_vocab]` f32.
+      - with top-k sampling (`top_k > 0`) and a data axis > 1, ONE more
+        all-gather: `lax.top_k` is a sort and GSPMD replicates its batch
+        axis over `data` — the full `[N, padded_vocab]` f32 per step, a
+        real (measured, priced-in) cost of top-k truncation on a
+        data-sharded slot set. Greedy and temperature-only sampling
+        don't pay it.
+
+    Precondition: `cfg.heads % model == 0` (the recipe's grid picker
+    guarantees it) — with heads undividable the KV ring can't shard over
+    `model` and GSPMD inserts extra resharding all-reduces around the
+    cache that this formula deliberately refuses to model.
+
+    Byte counts are RESULT payloads, the convention
+    `obs.xla.collective_bytes` reports. On XLA:CPU the float wire is
+    f32 (the round-12 `wire_itemsize` lesson): audit with a f32
+    compute dtype for exact equality on any backend.
+    """
+    d = mesh.shape.get("data", 1)
+    m = mesh.shape.get("model", 1)
+    if slots % d:
+        raise ValueError(
+            f"slots={slots} must be a multiple of the data axis ({d}) — "
+            f"slots shard over it"
+        )
+    if m > 1 and cfg.heads % m:
+        raise ValueError(
+            f"heads={cfg.heads} must divide the model axis ({m}) for the "
+            f"closed-form decode audit — undividable heads leave the KV "
+            f"ring unsharded and GSPMD inserts resharding this formula "
+            f"does not model"
+        )
+    n_local = slots // d
+    act = n_local * cfg.dim * jnp.dtype(cfg.compute_dtype).itemsize
+    embed = n_local * cfg.dim * jnp.dtype(cfg.param_dtype).itemsize
+    out = {}
+    if m > 1:
+        out["all-reduce"] = {
+            "count": 2 * cfg.num_layers + 1,
+            "bytes": 2 * cfg.num_layers * act + embed,
+        }
+        logits = n_local * cfg.padded_vocab_size * 4  # f32 sample logits
+        out["all-gather"] = {"count": 1, "bytes": logits}
+        if top_k > 0 and d > 1:
+            out["all-gather"]["count"] += 1
+            out["all-gather"]["bytes"] += slots * cfg.padded_vocab_size * 4
+    return out

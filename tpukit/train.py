@@ -300,11 +300,16 @@ def generate_samples(
     tokenizer,
     prompts=GENERATION_PROMPTS,
     max_new_tokens: int = 20,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    seed: int = 0,
 ) -> list[str]:
-    """SPMD-safe qualitative eval: replicate params, then greedy-decode each
-    prompt. Every process must call this (the replication is collective);
-    each returns the same texts, and the caller prints on process 0 only —
-    the reference's rank-0 gating (main-ddp.py:170-174) moved from "only
+    """SPMD-safe qualitative eval: replicate params, then decode each
+    prompt (greedy by default; `temperature`/`top_k`/`seed` sample — round
+    14, through the serving engine's batched KV-cached decode). Every
+    process must call this (the replication is collective); each returns
+    the same texts, and the caller prints on process 0 only — the
+    reference's rank-0 gating (main-ddp.py:170-174) moved from "only
     rank 0 computes" (a deadlock for sharded state) to "all compute, rank 0
     prints"."""
     params = replicated_params(strategy, state)
@@ -312,7 +317,8 @@ def generate_samples(
     # per epoch instead of a serial compile+decode per prompt — `generate`
     # stays as the single-prompt API.
     return generate_batch(
-        params, cfg, list(prompts), tokenizer, max_new_tokens=max_new_tokens
+        params, cfg, list(prompts), tokenizer, max_new_tokens=max_new_tokens,
+        temperature=temperature, top_k=top_k, seed=seed,
     )
 
 

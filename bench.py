@@ -560,6 +560,127 @@ def bench_serving(cfg, n_dev, requests=32, slots=8, max_new=16):
     }
 
 
+def bench_paged_kv(cfg, n_dev, requests=24, max_new=12, slots=4):
+    """Paged-KV ladder (round 15, ROADMAP #2): ring vs paged vs paged+int8
+    at EQUAL KV HBM, on the same seeded stream.
+
+    The ring rung is the round-14 engine (per-slot full-width KV). The
+    paged rungs get a page pool sized to the ring's exact byte budget
+    (`serve.paged.pool_bytes`), so every difference is layout, not a
+    bigger memory grant:
+
+      - "paged" (f32 pages, same slot count): the parity rung — tokens
+        must be identical to the ring rung per request (`parity_ok`, the
+        acceptance bar's exactness bit) at ~equal throughput.
+      - "paged_int8": pages cost ~1/4 the bytes (int8 payload + packed
+        f32 block scales), so the same HBM holds ~4x pages; lanes are
+        raised to 4x the ring slots and `max_live_slots` measures how
+        many requests actually decode CONCURRENTLY — the >= 2x
+        slots-at-equal-HBM acceptance bar, with `int8_token_agreement`
+        (mean per-request match vs the exact paged rung) as the honest
+        quality sidecar.
+
+    The prefix rung re-serves the paged config on a stream whose requests
+    share one system prompt: admissions that hit the prefix registry skip
+    the shared prefill chunks, and the record carries measured
+    hit-vs-cold admit latency plus the hit count."""
+    import time
+
+    import jax
+
+    from tpukit.data import get_tokenizer
+    from tpukit.model import init_params
+    from tpukit.serve import ServeConfig, ServeEngine, synthetic_request_stream
+    from tpukit.serve import paged as paged_lib
+
+    import jax.numpy as jnp
+
+    tokenizer = get_tokenizer()
+    tokenizer.pad_token_id = 2
+    # f32 compute for the whole ladder: the ring stores the COMPUTE dtype
+    # while pages store kv_dtype, so a bf16 ring against f32 pages would
+    # dtype-confound the equal-HBM sizing (half the token capacity for
+    # the parity rung, ~2x instead of ~4x pages for int8) — at f32 the
+    # ring and the f32-page rung are byte-comparable and the int8 ratio
+    # is the honest payload win.
+    cfg = cfg.replace(vocab_size=tokenizer.vocab_size,
+                      compute_dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    buckets = lengths = (8, 16)
+    page = 8  # page * head_dim is a 256 multiple at the ladder head_dim=32
+    eos = int(tokenizer.eos_token_id)
+    stream = synthetic_request_stream(
+        tokenizer, requests, seed=0, max_new_tokens=max_new,
+        buckets=buckets, lengths=lengths,
+    )
+
+    def run(serve, reqs):
+        ServeEngine(params, cfg, serve, eos_id=eos).run(list(reqs), max_wall_s=900)
+        eng = ServeEngine(params, cfg, serve, eos_id=eos)  # measured: warm jits
+        t0 = time.perf_counter()
+        comps = eng.run(list(reqs), max_wall_s=900)
+        wall = time.perf_counter() - t0
+        gen = sum(c.generated for c in comps)
+        s = eng.last_summary
+        rec = dict(
+            tokens_per_sec=round(gen / wall, 1), wall_s=round(wall, 3),
+            generated_tokens=gen, slots=serve.slots,
+            max_live_slots=s["max_live_slots"], kv_bytes=s["kv_bytes"],
+        )
+        return rec, {c.rid: list(map(int, c.ids)) for c in comps}, s
+
+    ring_cfg = ServeConfig(slots=slots, buckets=buckets,
+                           max_new_tokens=max_new, window_steps=10**9)
+    ring, ring_toks, _ = run(ring_cfg, stream)
+
+    per_page_f32 = paged_lib.pool_bytes(cfg, 1, page, "f32")
+    per_page_int8 = paged_lib.pool_bytes(cfg, 1, page, "int8")
+    min_pages = -(-(max(buckets) + max_new) // page) + 1  # one request + null
+    paged_cfg = ServeConfig(
+        slots=slots, buckets=buckets, max_new_tokens=max_new,
+        window_steps=10**9, page_size=page,
+        num_pages=max(ring["kv_bytes"] // per_page_f32, min_pages),
+    )
+    paged, paged_toks, _ = run(paged_cfg, stream)
+    parity = ring_toks == paged_toks
+
+    int8_cfg = ServeConfig(
+        slots=4 * slots, buckets=buckets, max_new_tokens=max_new,
+        window_steps=10**9, page_size=page, kv_dtype="int8",
+        num_pages=max(ring["kv_bytes"] // per_page_int8, min_pages),
+    )
+    int8, int8_toks, _ = run(int8_cfg, stream)
+    agree = [
+        float(np.mean(np.asarray(int8_toks[r][:m]) == np.asarray(paged_toks[r][:m])))
+        for r in paged_toks
+        for m in [min(len(int8_toks[r]), len(paged_toks[r]))]
+        if m
+    ]
+
+    shared = synthetic_request_stream(
+        tokenizer, requests, seed=0, max_new_tokens=max_new,
+        buckets=buckets, lengths=lengths, shared_prefix=page,
+    )
+    _, _, psum = run(paged_cfg, shared)
+    return {
+        "requests": requests, "buckets": list(buckets), "page_size": page,
+        "max_new_tokens": max_new,
+        "ring": ring, "paged": paged, "paged_int8": int8,
+        "parity_ok": bool(parity),
+        "int8_token_agreement": round(float(np.mean(agree)), 4) if agree else None,
+        "slots_at_equal_hbm_ratio": round(
+            int8["max_live_slots"] / max(ring["max_live_slots"], 1), 2
+        ),
+        "prefix": {
+            "hits": psum.get("prefix_hits"),
+            "hit_rate": psum.get("prefix_hit_rate"),
+            "pages_reused": psum.get("prefix_pages_reused"),
+            "admit_latency_hit_s": psum.get("admit_latency_hit_s"),
+            "admit_latency_cold_s": psum.get("admit_latency_cold_s"),
+        },
+    }
+
+
 def bench_quant_comm(cfg, n_dev, num_experts=8, steps=8):
     """Quantized-collective ladder (round 12, ROADMAP #2): f32 vs bf16 vs
     int8 `--comm_dtype` on each strategy with hand-wired quantized
@@ -908,6 +1029,17 @@ def main(argv=None):
         serving_rec = {"error": repr(exc)}
         print(f"serving probe failed: {exc!r}", file=sys.stderr)
 
+    # Paged KV (round 15, ROADMAP #2): ring vs paged vs paged+int8 at
+    # equal KV HBM — tokens/s, measured max concurrent slots (the >= 2x
+    # bar with int8 pages), the exact-parity bit, and prefix-hit vs cold
+    # admit latency on a shared-system-prompt stream.
+    paged_kv_rec = None
+    try:
+        paged_kv_rec = bench_paged_kv(cfg, n_dev)
+    except Exception as exc:
+        paged_kv_rec = {"error": repr(exc)}
+        print(f"paged kv probe failed: {exc!r}", file=sys.stderr)
+
     # Host input pipeline (round 7): sync data+h2d share vs the depth-2
     # prefetcher's residual stall share, with loss-parity proof.
     host_pipeline, host_pipeline_err = None, None
@@ -964,6 +1096,7 @@ def main(argv=None):
         "quant_comm": quant_comm_rec,
         "elastic_restore": elastic_restore,
         "serving": serving_rec,
+        "paged_kv": paged_kv_rec,
         "host_pipeline": host_pipeline,
         "host_pipeline_error": host_pipeline_err,
         "obs_overhead": obs_overhead,

@@ -19,11 +19,25 @@ StepLogger/flight-recorder/report stack that covers training
 (`python tools/report.py serve.jsonl`, with `--min_serve_tps` as the CI
 throughput gate).
 
+Round 15 (ROADMAP #2): `--page_size P` swaps the per-slot ring for the
+PAGED KV cache (tpukit/serve/paged.py) — fixed-size pages + per-slot
+block tables, request-granular allocation, shared-prefix reuse
+(admissions hitting the page-granular prefix registry skip the shared
+prefill entirely; `--shared_prefix N` gives the synthetic stream one
+system prompt), chunked prefill (`--prefill_chunk`), and int8 page
+payloads (`--kv_dtype int8`, ~4x pages per HBM byte, tolerance-gated).
+Paged serving picks a model-only grid (the page pool replicates over
+`data`); the checkpoint restore is params-ONLY either way
+(`checkpoint.restore_params`: the Adam moments — ~2/3 of the bytes —
+are never read, and any saved world lands at the serving shardings).
+
 Run examples:
   python main-serve.py --requests 64 --slots 8 --metrics_log serve.jsonl
   python main-serve.py --checkpoint latest --temperature 0.8 --top_k 40
   python main-serve.py --checkpoint checkpoints/step-200.msgpack \\
       --num_experts 8 --moe_dispatch pallas   # dropless MoE: exact cached
+  python main-serve.py --page_size 8 --shared_prefix 16 --requests 128 \\
+      --kv_dtype int8 --metrics_log serve.jsonl   # paged + prefix + int8
 """
 
 import argparse
@@ -55,32 +69,49 @@ def parse_serve_flags(argv=None):
                     help="path or 'latest'; empty serves fresh seeded params "
                     "(smoke/bench mode)")
     ap.add_argument("--seed", type=int, default=0)
-    # engine shape
-    ap.add_argument("--slots", type=int, default=8)
-    ap.add_argument("--buckets", type=str, default="16,32,64",
-                    help="comma-separated prompt-length buckets — the "
-                    "declared compile budget of the serve path")
-    ap.add_argument("--max_new_tokens", type=int, default=20)
-    ap.add_argument("--temperature", type=float, default=0.0)
-    ap.add_argument("--top_k", type=int, default=0)
-    ap.add_argument("--window_steps", type=int, default=32)
+    # engine shape (shared with bench.py via tpukit.flags.add_serve_flags)
+    from tpukit.flags import add_serve_flags
+
+    add_serve_flags(ap)
     # stream
     ap.add_argument("--requests", type=int, default=64)
     ap.add_argument("--qps", type=float, default=0.0,
                     help="0 = offered up front (saturation); >0 = seeded "
                     "exponential arrivals at this rate")
+    ap.add_argument("--shared_prefix", type=int, default=0,
+                    help="prepend the SAME n-token system prompt to every "
+                    "request (the shared-prefix-reuse shape; with "
+                    "--page_size the engine skips the shared prefill on "
+                    "prefix hits)")
     # telemetry
     ap.add_argument("--metrics_log", type=str, default="")
     ap.add_argument("--compilation_cache_dir", type=str, default="")
     return ap.parse_args(argv)
 
 
-def pick_serve_grid(n_devices: int, heads: int, slots: int) -> dict:
+def pick_serve_grid(n_devices: int, heads: int, slots: int,
+                    paged: bool = False) -> dict:
     """(data x model) serving grid: the largest model degree <= 4 dividing
     both the device count and the head count (the KV ring shards heads
     over `model`; main-tp.py's rule), remaining devices data-parallel —
     shrunk to the largest divisor of the slot count, since slots shard
-    over `data`."""
+    over `data`. Paged serving (round 15) requires a MODEL-ONLY grid —
+    the page pool is replicated across `data`, so a data axis > 1 would
+    make the pool write-back an unauditable cross-shard scatter
+    (serve.decode.decode_step_comm) — and therefore drops the <= 4 cap:
+    `model` grows to the LARGEST head-dividing degree so devices the
+    ring would have used as `data` aren't silently stranded."""
+    if paged:
+        # data is pinned to 1, so n_devices divisibility buys nothing —
+        # create_mesh takes a device subset when model < n_devices; only
+        # the head count constrains the degree
+        for model in range(min(n_devices, heads), 0, -1):
+            if heads % model == 0:
+                if model < n_devices:
+                    print(f"paged serving uses a model-only grid: "
+                          f"model={model} of {n_devices} devices "
+                          f"(model degree is capped by heads={heads})")
+                return {"data": 1, "model": model}
     for model in (4, 2, 1):
         if n_devices % model == 0 and heads % model == 0:
             data = n_devices // model
@@ -140,16 +171,14 @@ def main(argv=None):
         mesh = create_mesh({"data": data})
         strategy = DataParallel(mesh) if data > 1 else SingleDevice()
     else:
-        mesh = create_mesh(pick_serve_grid(n_dev, flags.heads, flags.slots))
+        mesh = create_mesh(pick_serve_grid(n_dev, flags.heads, flags.slots,
+                                           paged=flags.page_size > 0))
         strategy = TensorParallel(mesh)
     strategy.validate_config(cfg)
 
-    # Shapes only — serving never steps. The restore below reads the FULL
-    # TrainState (params + both Adam moments, ~3x the params bytes) and
-    # keeps only params: the checkpoint readers restore whole manifests/
-    # blobs against a structure-matched template. A params-only restore
-    # path (skip opt_state leaves at the reader) would cut serve cold-start
-    # I/O and transient memory ~3x — a future round's optimization.
+    # Shapes only — serving never steps, so only the params subtree of the
+    # TrainState is ever materialized (the optimizer here exists solely to
+    # derive the state's tree structure for the sharding specs).
     optimizer = make_optimizer(1e-4)
     init_fn = partial(create_train_state, cfg=cfg, optimizer=optimizer,
                       strategy=strategy)
@@ -172,44 +201,41 @@ def main(argv=None):
         saved_w = reshard_lib.saved_world(path)
         run_world = reshard_lib.current_world(strategy)
         mismatch = reshard_lib.describe_mismatch(saved_w, run_world)
-        if mismatch:
-            # the training world rarely equals the serving grid: round-13
-            # reshard-on-restore lands the saved state directly at the
-            # serving shardings, streaming block-by-block
-            try:
-                state, rs_info = reshard_lib.reshard_restore(
-                    path, state_shapes, state_sharding
-                )
-            except ValueError as exc:
-                raise ValueError(
-                    f"--checkpoint {path}: state structure does not match "
-                    f"the model flags (--dim/--heads/--num_layers/"
-                    f"--num_experts... must equal the training run's). "
-                    f"Original error: {exc}"
-                ) from exc
-            rec = dict(kind="resize", mismatch=mismatch,
-                       checkpoint=str(path), world=run_world, **rs_info)
-            logger.log(**rec)
-            recorder.record("resize", mismatch=mismatch)
-            if p0:
-                print(f"resharded for serving: {mismatch}")
-        else:
-            try:
-                state, _ = ckpt_lib.restore_any(path, state_shapes, state_sharding)
-            except ValueError as exc:
-                # flax's structure mismatch is deep and unnamed — say what
-                # it almost always means at this surface
-                raise ValueError(
-                    f"--checkpoint {path}: state structure does not match "
-                    f"the model flags (--dim/--heads/--num_layers/"
-                    f"--num_experts... must equal the training run's). "
-                    f"Original error: {exc}"
-                ) from exc
-        params = state.params
+        # Round 15: params-ONLY restore — the full-TrainState restore read
+        # params + both Adam moments (~3x the params bytes; the documented
+        # round-14 future optimization). `restore_params` filters the
+        # sharded manifest to the `.params` leaves from npy headers alone
+        # and places them straight at the serving shardings; because
+        # leaves are assembled whole and placed at the TARGET shardings, a
+        # training world that differs from the serving grid needs no
+        # reshard pass for a params-only read.
+        try:
+            params, rs_info = ckpt_lib.restore_params(
+                path, state_shapes.params, state_sharding.params
+            )
+        except ValueError as exc:
+            # flax's structure mismatch is deep and unnamed — say what
+            # it almost always means at this surface
+            raise ValueError(
+                f"--checkpoint {path}: state structure does not match "
+                f"the model flags (--dim/--heads/--num_layers/"
+                f"--num_experts... must equal the training run's). "
+                f"Original error: {exc}"
+            ) from exc
+        rec = dict(kind="ckpt_restore", params_only=True,
+                   checkpoint=str(path), mismatch=mismatch or "",
+                   world=run_world, **rs_info)
+        logger.log(**rec)
+        recorder.record("ckpt_restore", params_only=True,
+                        mismatch=mismatch or "")
         if p0:
-            print(f"serving checkpoint {path} (step "
-                  f"{int(jax.device_get(state.step))})")
-        del state
+            step = ckpt_lib._step_of(ckpt_lib.Path(path))
+            skipped = rs_info.get("bytes_skipped", 0)
+            print(f"serving checkpoint {path} ("
+                  + (f"step {step}, " if step >= 0 else "")
+                  + f"params-only restore: {rs_info['bytes_read']} B read"
+                  + (f", {skipped} B of opt state skipped" if skipped else "")
+                  + (f"; cross-world: {mismatch}" if mismatch else "") + ")")
     else:
         # smoke/bench mode: fresh seeded params directly at the shardings
         params = jax.jit(
@@ -224,12 +250,15 @@ def main(argv=None):
         max_new_tokens=flags.max_new_tokens,
         temperature=flags.temperature, top_k=flags.top_k,
         window_steps=flags.window_steps,
+        page_size=flags.page_size, num_pages=flags.num_pages,
+        kv_dtype=flags.kv_dtype, prefill_chunk=flags.prefill_chunk,
     )
     engine = ServeEngine(params, cfg, serve, eos_id=int(tokenizer.eos_token_id),
                          mesh=mesh, logger=logger, recorder=recorder)
     requests = synthetic_request_stream(
         tokenizer, flags.requests, seed=flags.seed,
         max_new_tokens=flags.max_new_tokens, buckets=buckets, qps=flags.qps,
+        shared_prefix=flags.shared_prefix,
     )
     t0 = time.perf_counter()
     completions = engine.run(requests)
@@ -242,6 +271,14 @@ def main(argv=None):
         print(f"served {len(completions)} requests / {gen} tokens in "
               f"{wall:.2f}s ({gen / wall:.1f} tokens/s, occupancy "
               f"{100 * occ:.0f}%)")
+        if serve.paged:
+            s = engine.last_summary or {}
+            print(f"paged KV: {s.get('num_pages')} pages x "
+                  f"{s.get('page_size')} tokens ({s.get('kv_dtype')}), "
+                  f"prefix hits {s.get('prefix_hits', 0)}/"
+                  f"{s.get('admitted', 0)} admissions, "
+                  f"{s.get('prefix_pages_reused', 0)} pages of prefill "
+                  f"skipped")
         if e2e:
             print(f"e2e latency p50 {1e3 * e2e[len(e2e) // 2]:.1f} ms  "
                   f"p99 {1e3 * e2e[min(len(e2e) - 1, int(len(e2e) * 0.99))]:.1f} ms")

@@ -385,6 +385,20 @@ def summarize(records: list[dict]) -> str:
           f" / decode {r.get('decode_s', 0):.2f}s"
           f" / sync {r.get('sync_s', 0):.2f}s)   evicted: "
           f"{r.get('evicted_eos', 0)} eos, {r.get('evicted_length', 0)} length")
+        # round-15 paged KV: pool pressure + the prefill work prefix
+        # reuse deleted (fields only present on paged runs)
+        if r.get("page_size"):
+            hit_s = r.get("admit_latency_hit_s")
+            cold_s = r.get("admit_latency_cold_s")
+            w(f"  paged KV: {r.get('num_pages', '?')} pages x "
+              f"{r.get('page_size', '?')} tokens ({r.get('kv_dtype', '?')}), "
+              f"occupancy {100 * (r.get('page_occupancy') or 0):.0f}%, "
+              f"{r.get('pages_per_request') or 0:.1f} pages/request   "
+              f"prefix hits {r.get('prefix_hits', 0)} "
+              f"({100 * (r.get('prefix_hit_rate') or 0):.0f}%), "
+              f"{r.get('prefix_pages_reused', 0)} pages skipped"
+              + (f"   admit hit/cold {hit_s * 1e3:.1f}/{cold_s * 1e3:.1f} ms"
+                 if hit_s is not None and cold_s is not None else ""))
     if serve_wins:
         occ = [r["occupancy"] for r in serve_wins if r.get("occupancy") is not None]
         tps = [r["tokens_per_sec"] for r in serve_wins if r.get("tokens_per_sec")]
@@ -517,6 +531,45 @@ def summarize(records: list[dict]) -> str:
         if spc is not None:
             w(f"  vs the strongest serial baseline (forced cached "
               f"while_loop): {spc:.2f}x")
+    # round-15 paged-KV bench (ROADMAP #2): ring vs paged vs paged+int8 at
+    # EQUAL KV HBM — the >= 2x concurrent-slots bar with int8 pages, the
+    # exact-parity bit, and prefix-hit vs cold admit latency.
+    for r in records:
+        pk = r.get("paged_kv")
+        if not isinstance(pk, dict):
+            continue
+        w("== paged kv (bench, equal KV HBM) ==")
+        if "error" in pk:
+            w(f"  ERROR {pk['error']}")
+            continue
+        w(f"  stream: {pk.get('requests', '?')} requests, buckets "
+          f"{pk.get('buckets', '?')}, page {pk.get('page_size', '?')} tokens")
+        for name in ("ring", "paged", "paged_int8"):
+            row = pk.get(name)
+            if not row:
+                continue
+            w(f"  {name:<11} {human_count(row.get('tokens_per_sec'))} tokens/s"
+              f"   slots {row.get('max_live_slots', '?')}/"
+              f"{row.get('slots', '?')} live   KV "
+              f"{human_bytes(row.get('kv_bytes'))}")
+        ratio = pk.get("slots_at_equal_hbm_ratio")
+        if ratio is not None:
+            w(f"  headline: {ratio:.2f}x concurrent slots at equal KV HBM "
+              f"with int8 pages"
+              + ("" if ratio >= 2.0 else "  <- BELOW the 2x acceptance bar"))
+        w("  paged f32 parity vs ring: "
+          + ("token-exact" if pk.get("parity_ok") else "<- MISMATCH")
+          + (f"   int8 token agreement {100 * pk['int8_token_agreement']:.1f}%"
+             if pk.get("int8_token_agreement") is not None else ""))
+        px = pk.get("prefix") or {}
+        if px.get("hits") is not None:
+            hit_s, cold_s = px.get("admit_latency_hit_s"), px.get("admit_latency_cold_s")
+            w(f"  shared-prefix stream: {px['hits']} hits "
+              f"({100 * (px.get('hit_rate') or 0):.0f}% of admissions), "
+              f"{px.get('pages_reused', 0)} pages of prefill skipped"
+              + (f"   admit latency hit/cold {hit_s * 1e3:.1f}/"
+                 f"{cold_s * 1e3:.1f} ms" if hit_s is not None
+                 and cold_s is not None else ""))
     # round-11 dispatch ladder (ROADMAP #3): the three MoE dataflows side
     # by side at e8 top-1/top-2, MFU normalized by ACTIVE FLOPs (top_k
     # experts + router per token) so padding/dispatch waste reads as lost

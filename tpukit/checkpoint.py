@@ -448,6 +448,15 @@ def restore(template, path: str | os.PathLike):
         if "shape" in str(exc).lower():
             raise ValueError(f"{exc}\n{_VOCAB_PAD_HINT}") from exc
         raise
+    return _validate_restored(path, template, restored)
+
+
+def _validate_restored(path, template, restored):
+    """Leaf-shape validation shared by `restore` and `restore_params`:
+    flax's from_bytes/from_state_dict silently accept mismatched array
+    shapes in plain pytrees, which would surface later as an opaque
+    jit/sharding error — check every leaf against the template, adapting
+    identity-padded pipeline layer axes (`_adapt_layer_axis`)."""
     t_flat = jax.tree_util.tree_flatten_with_path(template)[0]
     r_leaves, r_def = jax.tree_util.tree_flatten(restored)
     out, changed = [], False
@@ -648,6 +657,150 @@ def restore_any(path: str | os.PathLike, template, sharding_tree=None):
     if path.is_dir():
         return restore_sharded(path, template, sharding_tree), True
     return restore(template, path), False
+
+
+_PARAMS_PREFIX = ".params"  # TrainState's params subtree in _leaf_paths form
+
+
+def restore_params(path: str | os.PathLike, params_template, sharding_tree=None):
+    """Params-ONLY restore of a TrainState checkpoint (round 15): serving
+    cold start never steps, so reading the Adam moments — ~2/3 of every
+    checkpoint's bytes — is pure waste. `params_template` is the params
+    subtree only (shapes or ShapeDtypeStructs); `sharding_tree` (its
+    matching sharding pytree) places leaves directly at the serving
+    shardings. Returns `(params, info)` with an I/O ledger in `info`.
+
+    Sharded checkpoints get the real 3x win: the manifest's leaf paths
+    name the `.params` subtree, and `_ShardReader.block_headers()` plans
+    which blocks to read from npy HEADERS alone — opt_state blocks are
+    never touched, and `info["bytes_skipped"]` records exactly what the
+    full restore would have read. Because leaves are assembled whole and
+    placed at the TARGET shardings, a checkpoint saved under any world
+    (device count, strategy) restores here without the reshard pass — a
+    world mismatch is pure data movement for params-only reads.
+    Consolidated msgpacks are one blob, so the file is still read once,
+    but only the params subtree is decoded into the template/devices —
+    the TrainState template, optimizer construction, and the 3x transient
+    host/device memory all drop out."""
+    path = Path(path)
+    if path.is_dir():
+        return _restore_params_sharded(path, params_template, sharding_tree)
+    blob = retry_io(_read_blob, path, label="ckpt_read")
+    raw = serialization.msgpack_restore(blob)
+    if not isinstance(raw, dict) or "params" not in raw:
+        raise ValueError(
+            f"checkpoint {path} has no 'params' subtree — not a TrainState "
+            f"checkpoint (top-level keys: {sorted(raw)[:8] if isinstance(raw, dict) else type(raw).__name__})"
+        )
+    restored = serialization.from_state_dict(params_template, raw["params"])
+    restored = _validate_restored(path, params_template, restored)
+    if sharding_tree is not None:
+        restored = jax.tree_util.tree_map(jax.device_put, restored, sharding_tree)
+    n_params = len(jax.tree_util.tree_leaves(params_template))
+    info = {
+        "format": "consolidated",
+        "bytes_read": len(blob),
+        "bytes_skipped": 0,
+        "leaves_read": n_params,
+        "leaves_skipped": len(jax.tree_util.tree_leaves(raw)) - n_params,
+    }
+    return restored, info
+
+
+def _restore_params_sharded(base: Path, params_template, sharding_tree):
+    """Sharded half of `restore_params`: filter the manifest to the
+    `.params` leaves (the subtree flattens in the same relative order as
+    the full state, so saved indices zip with the template's leaves),
+    plan block reads from headers only, and place each assembled leaf at
+    its target sharding."""
+    import numpy as np
+
+    manifest, shard_files = _read_shard_manifest(base)
+    wanted = [
+        i for i, p in enumerate(manifest["paths"]) if p.startswith(_PARAMS_PREFIX)
+    ]
+    flat, treedef = jax.tree_util.tree_flatten(params_template)
+    if not wanted:
+        raise ValueError(
+            f"checkpoint {base} has no '{_PARAMS_PREFIX}' leaves — not a "
+            f"TrainState checkpoint"
+        )
+    if len(flat) != len(wanted):
+        raise ValueError(
+            f"checkpoint {base}: {len(wanted)} saved params leaves don't "
+            f"match the template's {len(flat)} — the model flags "
+            f"(--dim/--heads/--num_layers/--num_experts...) must equal the "
+            f"training run's"
+        )
+    shardings = _sharding_leaves(flat, sharding_tree)
+    readers = [_ShardReader(f) for f in shard_files]
+    wanted_set = set(wanted)
+    bytes_read = bytes_skipped = 0
+    plan: list[tuple] = []  # (reader, {saved leaf idx: [block keys]})
+    for ar in readers:
+        by_leaf: dict[int, list[str]] = {}
+        for key, (shape, dtype) in ar.block_headers().items():
+            idx, _ = _parse_block_key(key)
+            nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize if shape else np.dtype(dtype).itemsize
+            if idx in wanted_set:
+                by_leaf.setdefault(idx, []).append(key)
+                bytes_read += nbytes
+            else:
+                bytes_skipped += nbytes
+        plan.append((ar, by_leaf))
+    restored = []
+    for saved_idx, leaf, sharding in zip(wanted, flat, shardings):
+        meta = manifest["leaves"][saved_idx]
+        shape, dtype = tuple(meta["shape"]), np.dtype(meta["dtype"])
+        want = tuple(getattr(leaf, "shape", shape))
+        full = np.empty(shape, dtype)
+        covered = 0  # blocks are disjoint by construction (replica_id==0)
+        for ar, by_leaf in plan:
+            for key in by_leaf.get(saved_idx, ()):
+                block = ar.read(key)
+                _, starts = _parse_block_key(key)
+                if starts:
+                    idx = tuple(
+                        slice(st, st + bs) for st, bs in zip(starts, block.shape)
+                    )
+                    full[idx] = block
+                else:
+                    full[()] = block
+                covered += int(block.size) if block.shape else 1
+        expected = int(np.prod(shape)) if shape else 1
+        if covered != expected:
+            raise ValueError(
+                f"checkpoint {base}: params leaf "
+                f"({manifest['paths'][saved_idx]}) has {covered}/{expected} "
+                f"elements — a shard-*.npz file is missing (saved from "
+                f"{manifest['nprocs']} processes; are all shard files on "
+                f"this filesystem?)"
+            )
+        if want != shape:
+            adapted = _adapt_layer_axis(manifest["paths"][saved_idx], full, want)
+            if adapted is None:
+                raise ValueError(
+                    f"checkpoint {base}: params leaf "
+                    f"({manifest['paths'][saved_idx]}) was saved with shape "
+                    f"{shape} but the target expects {want}. {_VOCAB_PAD_HINT}"
+                )
+            full, shape = adapted, want
+        if sharding is not None:
+            restored.append(
+                jax.make_array_from_callback(shape, sharding, lambda i, f=full: f[i])
+            )
+        else:
+            restored.append(_as_jax_array(full))
+    for ar in readers:
+        ar.close()  # error paths are fatal; GC closes leaked handles
+    info = {
+        "format": "sharded",
+        "bytes_read": bytes_read,
+        "bytes_skipped": bytes_skipped,
+        "leaves_read": len(wanted),
+        "leaves_skipped": len(manifest["paths"]) - len(wanted),
+    }
+    return jax.tree_util.tree_unflatten(treedef, restored), info
 
 
 # ---------------------------------------------------------------------------

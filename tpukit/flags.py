@@ -326,3 +326,38 @@ def parse_flags(
     kw.setdefault("moe_top_k", 1)
     kw.setdefault("moe_dispatch", "a2a")
     return TrainFlags(**kw)
+
+
+def add_serve_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """The serving-engine shape flags (main-serve.py, recipe 9), one
+    spelling shared by the recipe and any harness that builds a
+    `ServeConfig` from a CLI. Round 15 adds the paged-KV group: pages +
+    block tables replace the per-slot ring when --page_size > 0, with
+    shared-prefix reuse, chunked prefill, and int8 page payloads riding
+    on top (tpukit/serve/paged.py; validation lives on ServeConfig and
+    the engine so misconfigurations fail with named errors, not XLA
+    shape errors)."""
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--buckets", type=str, default="16,32,64",
+                        help="comma-separated prompt-length buckets — the "
+                        "declared compile budget of the serve path")
+    parser.add_argument("--max_new_tokens", type=int, default=20)
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--top_k", type=int, default=0)
+    parser.add_argument("--window_steps", type=int, default=32)
+    parser.add_argument("--page_size", type=int, default=0,
+                        help="paged KV cache: token positions per page "
+                        "(must divide every bucket); 0 = the per-slot ring")
+    parser.add_argument("--num_pages", type=int, default=0,
+                        help="page-pool size; 0 = ring-equivalent HBM "
+                        "(slots x pages-per-slot + the null page)")
+    parser.add_argument("--kv_dtype", choices=("f32", "bf16", "int8"),
+                        default="f32",
+                        help="page payload storage; int8 block-quantizes "
+                        "page rows (quant_comm's 256-element blocks) for "
+                        "~4x pages per HBM byte, gated by a token-level "
+                        "tolerance test — requires --page_size")
+    parser.add_argument("--prefill_chunk", type=int, default=0,
+                        help="chunked-prefill tokens per dispatch (page "
+                        "multiple dividing every bucket); 0 = one page")
+    return parser

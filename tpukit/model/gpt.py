@@ -563,31 +563,133 @@ def _apply_attention_cached(layer, cfg: GPTConfig, x, k_cache, v_cache, start):
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, start, 0))
         q_pos = (start + jnp.arange(t))[None, None, :, None]
 
+    out = _attend_over_cache(layer, cfg, q, k_cache, v_cache, q_pos)
+    return out, k_cache, v_cache
+
+
+def _attend_over_cache(layer, cfg: GPTConfig, q, k_cache, v_cache, q_pos):
+    """The cached-attention read: scores over every cache position, causal
+    `key_pos <= q_pos` window, softmax, value mix, output projection. ONE
+    spelling shared by the ring path above and the paged path below —
+    masked positions softmax to exact zeros (exp underflows in f32) and
+    exact zeros annihilate whatever garbage the masked cache slots hold,
+    which is why the two storage layouts produce bit-identical outputs
+    for the same logical K/V (the paged parity bar, tests/test_paged.py).
+    """
+    batch, t = q.shape[0], q.shape[2]
+    s_max = k_cache.shape[2]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * (1.0 / cfg.head_dim**0.5)
     key_pos = jnp.arange(s_max)[None, None, None, :]
     scores = jnp.where(key_pos <= q_pos, scores, jnp.asarray(-1e9, scores.dtype))
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(v_cache.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache)
     out = out.transpose(0, 2, 1, 3).reshape(batch, t, cfg.inner_dim)
-    out = linear(out, layer["attn"]["out"], cfg.compute_dtype)
-    return out, k_cache, v_cache
+    return linear(out, layer["attn"]["out"], cfg.compute_dtype)
 
 
-def forward_cached(params: Params, cfg: GPTConfig, input_ids, position_ids, cache, start):
+def _apply_attention_paged(layer, cfg: GPTConfig, x, pool_k, pool_v,
+                           scale_k, scale_v, bt, start, write_mask):
+    """Attention for decode over the PAGED cache (round 15, ROADMAP #2):
+    the per-row-cursor indirection of the vector path above with one extra
+    hop — each row's K/V comes from fixed-size pages dereferenced through
+    its block-table row `bt [B, MP]` instead of a contiguous ring slice.
+
+    The gather (`serve.paged.gather_view`) materializes exactly the
+    `[B, H, MP*P, D]` per-row view the vector path writes and attends, the
+    chunk's fresh K/V is written into the view with the SAME vmapped
+    dynamic-update-slice, and the attend math is `_attend_over_cache`
+    verbatim — so for page storage at the compute dtype the outputs are
+    bit-identical to the ring path and the parity bar transfers. The only
+    paged-specific math is the write-back: the fresh K/V also lands in the
+    pool (single position for decode T==1, whole pages for a prefill
+    chunk — `start` page-aligned and T a page multiple, the engine's
+    chunking contract), with `write_mask`-False rows routed to the null
+    page so inactive/prefilling slots never touch a page another slot may
+    own. int8 pools dequantize after the gather and requantize written
+    rows (lossy — gated by tolerance, never claimed exact).
+
+    Under a serving mesh the pools shard heads-over-`model` and stay
+    replicated across `data` (the engine enforces a model-only grid for
+    paged serving): gather and scatter index only the unsharded page axis
+    with replicated indices, so the paged hop adds ZERO collectives — the
+    `decode_step_comm` closed form is unchanged and the compiled HLO must
+    still match it exactly (tests/test_paged.py)."""
+    from tpukit.serve import paged as paged_lib  # lazy: tpukit.serve imports gpt
+
+    batch, t = x.shape[0], x.shape[1]
+    q = linear(x, layer["attn"]["q"], cfg.compute_dtype)
+    k = linear(x, layer["attn"]["k"], cfg.compute_dtype)
+    v = linear(x, layer["attn"]["v"], cfg.compute_dtype)
+    split = lambda z: z.reshape(batch, t, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
+    q, k, v = split(q), split(k), split(v)
+
+    view_k = paged_lib.gather_view(pool_k, scale_k, bt, cfg.compute_dtype)
+    view_v = paged_lib.gather_view(pool_v, scale_v, bt, cfg.compute_dtype)
+    upd = lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (0, s, 0))
+    view_k = jax.vmap(upd)(view_k, k, start)
+    view_v = jax.vmap(upd)(view_v, v, start)
+    q_pos = (start[:, None] + jnp.arange(t))[:, None, :, None]
+    out = _attend_over_cache(layer, cfg, q, view_k, view_v, q_pos)
+
+    if t == 1:
+        pool_k, scale_k = paged_lib.write_token(
+            pool_k, scale_k, bt, start, k[:, :, 0, :], write_mask
+        )
+        pool_v, scale_v = paged_lib.write_token(
+            pool_v, scale_v, bt, start, v[:, :, 0, :], write_mask
+        )
+    else:
+        pool_k, scale_k = paged_lib.write_pages(pool_k, scale_k, bt, start, k, write_mask)
+        pool_v, scale_v = paged_lib.write_pages(pool_v, scale_v, bt, start, v, write_mask)
+    return out, pool_k, pool_v, scale_k, scale_v
+
+
+def forward_cached(params: Params, cfg: GPTConfig, input_ids, position_ids,
+                   cache, start, write_mask=None):
     """Forward a chunk of tokens with the KV cache: writes K/V for positions
     `[start, start+T)` and returns `(logits [B, T, padded_vocab], cache)`.
     Prefill with the prompt chunk, then decode with T=1 per step. `start`
     is a scalar offset shared by every row, or a `[B]` vector of per-row
     offsets (the continuous-batching decode step — see
-    `_apply_attention_cached`)."""
+    `_apply_attention_cached`).
+
+    `cache` is either the contiguous ring (`init_kv_cache`) or the paged
+    pytree (`serve.paged.init_paged_cache`, detected by its `"bt"` block
+    tables — round 15): paged caches require a vector `start` and route
+    each layer through `_apply_attention_paged`, with `write_mask [B]`
+    (default all-True) gating which rows' K/V reach the pool — the paged
+    engine passes the live-slot mask so an inactive lane's re-forward can
+    never write a page it no longer owns. The ring path ignores
+    `write_mask` and keeps its original trace byte-unchanged."""
+    paged = isinstance(cache, dict) and "bt" in cache
+    if paged:
+        bt = cache["bt"]
+        if jnp.ndim(start) != 1:
+            raise ValueError(
+                "paged forward_cached requires a [B] vector `start` (each "
+                "row sits at its own cursor through its block table)"
+            )
+        if write_mask is None:
+            write_mask = jnp.ones((bt.shape[0],), bool)
+        quant = "ks" in cache
     x = apply_embeddings(params, cfg, input_ids, position_ids)
-    new_k, new_v = [], []
+    new_k, new_v, new_ks, new_vs = [], [], [], []
     for i in range(cfg.num_layers):
         layer = jax.tree_util.tree_map(lambda t: t[i], params["layers"])
         h = layer_norm(x, layer["norm1"]).astype(cfg.compute_dtype)
-        attn, k_c, v_c = _apply_attention_cached(
-            layer, cfg, h, cache["k"][i], cache["v"][i], start
-        )
+        if paged:
+            attn, k_c, v_c, ks_c, vs_c = _apply_attention_paged(
+                layer, cfg, h, cache["k"][i], cache["v"][i],
+                cache["ks"][i] if quant else None,
+                cache["vs"][i] if quant else None,
+                bt, start, write_mask,
+            )
+            new_ks.append(ks_c)
+            new_vs.append(vs_c)
+        else:
+            attn, k_c, v_c = _apply_attention_cached(
+                layer, cfg, h, cache["k"][i], cache["v"][i], start
+            )
         new_k.append(k_c)
         new_v.append(v_c)
         x = x + attn
@@ -598,6 +700,11 @@ def forward_cached(params: Params, cfg: GPTConfig, input_ids, position_ids, cach
         else:
             x = x + _apply_feed_forward(layer, cfg, h, None, True)
     cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    if paged:
+        cache["bt"] = bt
+        if quant:
+            cache["ks"] = jnp.stack(new_ks)
+            cache["vs"] = jnp.stack(new_vs)
     return apply_head(params, cfg, x), cache
 
 

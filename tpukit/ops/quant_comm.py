@@ -113,15 +113,19 @@ def packed_bytes(n: int, block: int = DEFAULT_BLOCK) -> int:
 
 
 def quantize_blocks(x, block: int = DEFAULT_BLOCK, rng=None):
-    """Quantize `x` [rows, chunk] (chunk % block == 0) to
-    (q int8 [rows, chunk], scales f32 [rows, chunk // block]).
+    """Quantize `x` [..., chunk] (chunk % block == 0) to
+    (q int8 [..., chunk], scales f32 [..., chunk // block]). Leading axes
+    are PRESERVED, never merged — a sharded leading axis (the paged KV
+    pools' head axis, round 15) stays sharded through the quantizer
+    instead of forcing a GSPMD reshard around a rows-merge.
 
     Per-block max-abs scaling: scale = max|x| / 127 over each block;
     q = round(x / scale) in [-127, 127]. `rng` switches round-to-nearest
     to stochastic rounding (floor(v + U[0,1)) — unbiased per element)."""
-    rows, chunk = x.shape
-    xb = x.astype(jnp.float32).reshape(rows, chunk // block, block)
-    amax = jnp.max(jnp.abs(xb), axis=-1)  # [rows, S]
+    chunk = x.shape[-1]
+    lead = x.shape[:-1]
+    xb = x.astype(jnp.float32).reshape(*lead, chunk // block, block)
+    amax = jnp.max(jnp.abs(xb), axis=-1)  # [..., S]
     scales = amax / 127.0
     inv = jnp.where(amax > 0, 127.0 / jnp.where(amax > 0, amax, 1.0), 0.0)
     v = xb * inv[..., None]
@@ -129,15 +133,16 @@ def quantize_blocks(x, block: int = DEFAULT_BLOCK, rng=None):
         v = jnp.floor(v + jax.random.uniform(rng, v.shape))
     else:
         v = jnp.round(v)
-    q = jnp.clip(v, -127, 127).astype(jnp.int8).reshape(rows, chunk)
+    q = jnp.clip(v, -127, 127).astype(jnp.int8).reshape(x.shape)
     return q, scales
 
 
 def dequantize_blocks(q, scales, block: int = DEFAULT_BLOCK):
-    """Inverse of quantize_blocks: f32 [rows, chunk]."""
-    rows, chunk = q.shape
-    xb = q.astype(jnp.float32).reshape(rows, chunk // block, block)
-    return (xb * scales[..., None]).reshape(rows, chunk)
+    """Inverse of quantize_blocks: f32 [..., chunk] (leading axes
+    preserved, same sharding rationale)."""
+    chunk = q.shape[-1]
+    xb = q.astype(jnp.float32).reshape(*q.shape[:-1], chunk // block, block)
+    return (xb * scales[..., None]).reshape(q.shape)
 
 
 def quantize_blockwise(x, block: int = DEFAULT_BLOCK, rng=None):

@@ -3,13 +3,17 @@
 Device programs (batched KV-cached decode, per-bucket prefill, the fused
 whole-batch loop, the TP comm audit) in `decode.py`; the host-side slot
 scheduler, request/completion types, serving telemetry and the synthetic
-stream in `engine.py`. Recipe: `main-serve.py`.
+stream in `engine.py`; the paged KV cache — page pool + block tables,
+shared-prefix registry, chunked prefill, int8 page payloads (round 15,
+ROADMAP #2) — in `paged.py`. Recipe: `main-serve.py`.
 """
 
+from tpukit.serve import paged  # noqa: F401
 from tpukit.serve.decode import (  # noqa: F401
     decode_loop,
     decode_step,
     decode_step_comm,
+    prefill_chunk_paged,
     prefill_slots,
 )
 from tpukit.serve.engine import (  # noqa: F401

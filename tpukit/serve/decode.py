@@ -49,6 +49,15 @@ sharding constraint pins the step's sampled logits to model-replicated —
 one all-gather per step at a known size — so the per-step collectives
 have a closed form (`decode_step_comm`) the compiled HLO must match
 (the round-10/12 audit discipline, tests/test_serve.py).
+
+Paged KV (round 15, tpukit/serve/paged.py): when the cache pytree
+carries block tables (`"bt"`), the same programs run against the page
+pool — `decode_step` threads the live-slot mask into the pool
+write-back, `prefill_chunk_paged` replaces the per-bucket
+`prefill_slots` with chunked whole-page prefill, and
+`decode_step_comm(paged=True)` extends the audit (the paged gather adds
+ZERO collectives on the model-only paged grid). The ring programs and
+their traces are byte-unchanged.
 """
 
 from __future__ import annotations
@@ -88,9 +97,21 @@ def _advance(params, cfg, buf, cache, cursors, active, limits, keys,
     n, total = buf.shape
     read = jnp.clip(cursors - 1, 0, total - 1)
     tok = jnp.take_along_axis(buf, read[:, None], axis=1)
-    logits, cache = gpt.forward_cached(
-        params, cfg, tok, read[:, None].astype(jnp.int32), cache, read
-    )
+    if "bt" in cache:
+        # Paged cache (round 15): the re-forward of an inactive lane must
+        # NOT reach the page pool — a freed lane's block-table row may
+        # alias pages the allocator has re-issued, and a prefilling lane's
+        # cursor-0 write would corrupt its own first page. `write_mask`
+        # routes masked rows to the null page; the ring path needs no mask
+        # because each slot exclusively owns its full-width ring rows.
+        logits, cache = gpt.forward_cached(
+            params, cfg, tok, read[:, None].astype(jnp.int32), cache, read,
+            write_mask=active,
+        )
+    else:
+        logits, cache = gpt.forward_cached(
+            params, cfg, tok, read[:, None].astype(jnp.int32), cache, read
+        )
     last = logits[:, -1].astype(jnp.float32)
     if mesh is not None and "model" in mesh.axis_names:
         # Pin the sampled logits model-replicated (slots stay data-sharded):
@@ -216,6 +237,49 @@ def prefill_slots(params, cfg: gpt.GPTConfig, buf, cache, cursors, active,
     return buf, cache, cursors, active, limits, keys
 
 
+# No donation — see the decode_step note (persistent-cache deserialization
+# of donated executables mis-aliases on this jaxlib).
+@partial(jax.jit, static_argnames=("cfg",))
+def prefill_chunk_paged(params, cfg: gpt.GPTConfig, buf, cache, cursors,
+                        active, limits, keys, slots, rows, starts, is_last,
+                        prompt_lens, new_limits, new_keys):
+    """One CHUNKED-PREFILL dispatch against the paged cache (round 15):
+    forward `rows [A, C]` — each lane's next `C` prompt tokens at logical
+    positions `[starts[i], starts[i] + C)` — through the lanes' block
+    tables in ONE batched call, writing whole pages (`starts` page-aligned
+    and C a page multiple, the engine's chunking contract; C is the
+    static `ServeConfig.chunk`). A long prompt is split across scheduler
+    iterations — one chunk per lane per iteration, decode quanta running
+    in between — so an 8k prompt can never stall admission or active
+    slots for more than one chunk's compute.
+
+    A chunk's attention reads everything its lane's block table already
+    holds: earlier chunks AND shared-prefix pages another request
+    prefilled (skipping the shared compute entirely is the prefix-reuse
+    win). Rows on their LAST chunk (`is_last`) arm the lane's decode
+    state — cursor to the prompt length, limit, per-request key, active.
+    The admit batch pads to a power of two by REPEATING entries (the
+    round-14 idempotence trick: a repeated row rewrites the same pages
+    and lane state with the same values), so compiles stay bounded by the
+    power-of-two admit sizes — one program per (A, C) pair."""
+    a, c = rows.shape
+    bt = cache["bt"]
+    sub = dict(cache, bt=bt[slots])  # the A lanes' block-table rows
+    pos = starts[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+    _, sub = gpt.forward_cached(params, cfg, rows, pos, sub, starts)
+    cache = dict(sub, bt=bt)  # pools carry the writes; global tables kept
+    for i in range(a):  # A is static and small: unrolled lane updates
+        buf = jax.lax.dynamic_update_slice(
+            buf, rows[i : i + 1].astype(buf.dtype), (slots[i], starts[i])
+        )
+        arm = is_last[i]
+        cursors = jnp.where(arm, cursors.at[slots[i]].set(prompt_lens[i]), cursors)
+        active = jnp.where(arm, active.at[slots[i]].set(True), active)
+        limits = jnp.where(arm, limits.at[slots[i]].set(new_limits[i]), limits)
+        keys = jnp.where(arm, keys.at[slots[i]].set(new_keys[i]), keys)
+    return buf, cache, cursors, active, limits, keys
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "eos_id", "temperature", "top_k"),
@@ -261,7 +325,8 @@ def decode_loop(params, cfg: gpt.GPTConfig, buf, prompt_lens,
     return buf, cursors
 
 
-def decode_step_comm(cfg: gpt.GPTConfig, mesh, slots: int, top_k: int = 0) -> dict:
+def decode_step_comm(cfg: gpt.GPTConfig, mesh, slots: int, top_k: int = 0,
+                     paged: bool = False) -> dict:
     """Closed-form PER-DEVICE collective expectation for one compiled
     `decode_step` under a (data x model) serving mesh — the round-10/12
     audit discipline applied to the decode path: the compiled HLO's
@@ -296,9 +361,27 @@ def decode_step_comm(cfg: gpt.GPTConfig, mesh, slots: int, top_k: int = 0) -> di
     `obs.xla.collective_bytes` reports. On XLA:CPU the float wire is
     f32 (the round-12 `wire_itemsize` lesson): audit with a f32
     compute dtype for exact equality on any backend.
+
+    `paged=True` (round 15) extends the audit to the paged gather: the
+    page pools shard heads over `model` and are REPLICATED across `data`,
+    and the block tables are replicated — so the gather (page axis,
+    replicated indices) and the pool write-back scatter are comm-free and
+    the formula above is UNCHANGED. That only holds with a 1-sized data
+    axis: data-sharded slots writing into a data-replicated pool would
+    force GSPMD to reconcile the scatter with version-dependent index
+    plumbing this formula refuses to model, so paged + data > 1 raises
+    here (and at engine construction) instead of drifting from the HLO.
     """
     d = mesh.shape.get("data", 1)
     m = mesh.shape.get("model", 1)
+    if paged and d > 1:
+        raise ValueError(
+            f"paged KV serving requires a model-only grid (data axis 1, "
+            f"got data={d}): the page pool is replicated across `data`, "
+            f"and a data-sharded slot set would turn the pool write-back "
+            f"into an unauditable cross-shard scatter — shrink the data "
+            f"axis or use the ring cache (page_size=0)"
+        )
     if slots % d:
         raise ValueError(
             f"slots={slots} must be a multiple of the data axis ({d}) — "

@@ -68,7 +68,11 @@ class Request:
 @dataclasses.dataclass
 class Completion:
     """A finished request. `ids` holds prompt + generated tokens;
-    timestamps are engine-clock seconds (run-relative)."""
+    timestamps are engine-clock seconds (run-relative). The paged fields
+    (round 15) are 0/absent under the ring cache: `pages` is the request's
+    page footprint, `prefix_pages` how many of them were shared-prefix
+    hits, and `active_s` when its prefill finished and decode began
+    (== `admit_s` for the ring's one-shot prefill)."""
 
     rid: int
     ids: np.ndarray
@@ -78,6 +82,15 @@ class Completion:
     arrival_s: float
     admit_s: float
     done_s: float
+    pages: int = 0
+    prefix_pages: int = 0
+    active_s: float = 0.0
+
+    @property
+    def admit_latency_s(self) -> float:
+        """Slot-assignment to decode-ready: the prefill cost a request
+        actually paid — what shared-prefix reuse shrinks."""
+        return max(self.active_s - self.admit_s, 0.0)
 
     @property
     def e2e_s(self) -> float:
@@ -113,6 +126,30 @@ class ServeConfig:
     # streams are identical at any quantum — finished slots freeze
     # mid-quantum — only latency granularity changes.
     decode_quantum: int = 4
+    # Paged KV (round 15, ROADMAP #2). 0 = the round-14 per-slot ring
+    # (byte-identical behavior). > 0 = fixed-size pages of this many token
+    # positions + per-slot block tables (serve/paged.py): requests hold
+    # ceil(min(prompt+budget, width)/page_size) pages instead of a
+    # full-width slot, prompt prefixes are shared page-granular across
+    # requests, and prefill runs CHUNKED between decode quanta. Page size
+    # must divide every bucket so admit chunks stay page-aligned.
+    page_size: int = 0
+    # Page-pool size; 0 derives the ring-equivalent pool (slots x
+    # pages-per-slot + the null page) — same KV HBM, so the paged win
+    # reads as footprint, not as a bigger budget. The bench shrinks/grows
+    # it explicitly for the equal-HBM comparison.
+    num_pages: int = 0
+    # Page payload storage: "f32"/"bf16" store that dtype (token-exact
+    # when it equals the compute dtype); "int8" block-quantizes page rows
+    # with quant_comm's 256-element-block quantizer for ~4x pages per HBM
+    # byte — lossy, gated by a token-level tolerance test, never claimed
+    # token-exact. Non-f32 requires the paged cache.
+    kv_dtype: str = "f32"
+    # Chunked-prefill chunk (tokens per prefill dispatch, page multiple);
+    # 0 = one page per chunk. A lane advances one chunk per scheduler
+    # iteration with decode quanta in between, so a long prompt can never
+    # stall active slots for more than one chunk's compute.
+    prefill_chunk: int = 0
 
     def __post_init__(self):
         if self.slots < 1:
@@ -135,19 +172,91 @@ class ServeConfig:
                 f"({max(b)}) — a prompt admitted at that bucket could not fit "
                 f"the KV ring (it would crash at prefill, not here)"
             )
+        from tpukit.serve import paged as paged_lib
+
+        if self.kv_dtype not in paged_lib.KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {paged_lib.KV_DTYPES}, "
+                f"got {self.kv_dtype!r}"
+            )
+        if self.page_size < 0:
+            raise ValueError(f"page_size={self.page_size} must be >= 0")
+        if self.page_size == 0:
+            if self.kv_dtype != "f32":
+                raise ValueError(
+                    f"kv_dtype={self.kv_dtype!r} requires the paged cache "
+                    f"(page_size > 0) — the ring stores the compute dtype"
+                )
+            for name in ("num_pages", "prefill_chunk"):
+                if getattr(self, name):
+                    raise ValueError(
+                        f"{name}={getattr(self, name)} requires the paged "
+                        f"cache (page_size > 0)"
+                    )
+            return
+        bad = [x for x in b if x % self.page_size]
+        if bad:
+            raise ValueError(
+                f"page_size={self.page_size} must divide every bucket "
+                f"width (buckets {bad} don't tile) — admit chunks are "
+                f"page-aligned whole-page writes"
+            )
+        if self.prefill_chunk and self.prefill_chunk % self.page_size:
+            raise ValueError(
+                f"prefill_chunk={self.prefill_chunk} must be a multiple of "
+                f"page_size={self.page_size} — chunks write whole pages"
+            )
+        if self.prefill_chunk:
+            bad = [x for x in b if x % self.prefill_chunk]
+            if bad:
+                raise ValueError(
+                    f"prefill_chunk={self.prefill_chunk} must divide every "
+                    f"bucket width (buckets {bad} don't tile) — a partial "
+                    f"tail chunk would write past its bucket row"
+                )
+        if self.num_pages and self.num_pages - 1 < self.pages_per_slot:
+            raise ValueError(
+                f"num_pages={self.num_pages} cannot hold even one "
+                f"worst-case request ({self.pages_per_slot} pages for "
+                f"width {self.width}, plus the reserved null page)"
+            )
 
     @property
     def width(self) -> int:
         return self.max_len or (max(self.buckets) + self.max_new_tokens)
 
     @property
+    def paged(self) -> bool:
+        return self.page_size > 0
+
+    @property
+    def pages_per_slot(self) -> int:
+        """Block-table width: pages covering the worst-case logical
+        sequence. Only meaningful when paged."""
+        return -(-self.width // self.page_size)
+
+    @property
+    def padded_width(self) -> int:
+        """Logical per-slot width of the paged view (width rounded up to
+        whole pages); == `width` for the ring."""
+        return self.pages_per_slot * self.page_size if self.paged else self.width
+
+    @property
+    def chunk(self) -> int:
+        """Chunked-prefill chunk actually used (paged only)."""
+        return self.prefill_chunk or self.page_size
+
+    @property
     def compile_budget(self) -> int:
         """Declared ceiling on serve-path compiles: ONE decode program
-        (at this quantum) plus one prefill program per (bucket,
-        power-of-two admit size <= slots) pair — the admit batcher pads
-        group sizes to powers of two precisely so this stays a small
-        static set (asserted in tests/test_serve.py)."""
+        (at this quantum) plus one prefill program per admit size — the
+        admit batcher pads group sizes to powers of two precisely so this
+        stays a small static set (asserted in tests). Ring prefills
+        compile per (bucket, admit size); paged chunked prefills have ONE
+        static chunk width, so only the admit sizes multiply."""
         admit_sizes = (self.slots - 1).bit_length() + 1
+        if self.paged:
+            return 1 + admit_sizes
         return 1 + len(self.buckets) * admit_sizes
 
 
@@ -157,6 +266,20 @@ class _Lane:
     admit_s: float
     prompt_len: int
     bucket: int
+    # paged-only state (round 15): the lane's page footprint (shared
+    # prefix first, then private pages), how many lead pages are shared
+    # read-only hits, the chunked-prefill cursor (next chunk start; the
+    # lane is decoding once it reaches `prefill_end`), and when decode
+    # became ready.
+    pages: list[int] = dataclasses.field(default_factory=list)
+    shared: int = 0
+    next_chunk: int = 0
+    prefill_end: int = 0
+    phase: str = "decode"  # "prefill" until the last chunk is dispatched
+    active_s: float = 0.0
+    # per-request PRNG key bytes, computed ONCE at admission — chunk
+    # dispatches must not pay a device round-trip per lane per iteration
+    key: np.ndarray | None = None
 
 
 def _pct(vals, q) -> float | None:
@@ -190,8 +313,13 @@ class ServeEngine:
         self.recorder = recorder
         # lax.top_k rejects k beyond the logits width — clamp like generate()
         self._top_k = min(int(serve.top_k), cfg.padded_vocab_size)
-        n, w = serve.slots, serve.width
+        n, w = serve.slots, serve.padded_width
 
+        if serve.paged:
+            from tpukit.serve import paged as paged_lib
+
+            # named at construction, not an XLA shape error at first write
+            paged_lib.validate_kv_layout(cfg, serve.page_size, serve.kv_dtype)
         if mesh is not None:
             from tpukit.mesh import place_host_array
 
@@ -204,6 +332,15 @@ class ServeEngine:
                     "future round"
                 )
             d = mesh.shape.get("data", 1)
+            if serve.paged and d > 1:
+                raise ValueError(
+                    f"paged serving requires a model-only grid (data axis "
+                    f"1, got data={d}): the page pool is replicated across "
+                    f"`data` and a data-sharded slot set would make the "
+                    f"pool write-back an unauditable cross-shard scatter "
+                    f"(decode.decode_step_comm) — shrink the data axis or "
+                    f"use the ring cache (page_size=0)"
+                )
             if n % d:
                 raise ValueError(
                     f"slots={n} must be a multiple of the mesh's data axis "
@@ -218,16 +355,40 @@ class ServeEngine:
                 np.asarray(x), NamedSharding(mesh, spec)
             )
             cache_spec = P(None, batch_ax, heads_ax, None, None)
+            pool_spec = P(None, None, heads_ax, None, None)
+            scale_spec = P(None, None, heads_ax, None)
             slot_spec = P(batch_ax)
         else:
             place = lambda x, spec: jnp.asarray(x)
-            cache_spec = slot_spec = P()
+            cache_spec = pool_spec = scale_spec = slot_spec = P()
         self._place = place
 
         self.buf = place(np.zeros((n, w), np.int32), P(*slot_spec, None))
-        self.cache = jax.tree.map(
-            lambda c: place(c, cache_spec), gpt.init_kv_cache(cfg, n, w)
-        )
+        if serve.paged:
+            self.num_pages = serve.num_pages or n * serve.pages_per_slot + 1
+            tree = paged_lib.init_paged_cache(
+                cfg, self.num_pages, serve.page_size, serve.pages_per_slot,
+                n, serve.kv_dtype,
+            )
+            specs = {"k": pool_spec, "v": pool_spec, "ks": scale_spec,
+                     "vs": scale_spec, "bt": P()}
+            self.cache = {key: place(val, specs[key]) for key, val in tree.items()}
+            self.allocator = paged_lib.PageAllocator(
+                self.num_pages, serve.page_size
+            )
+            self.kv_bytes = paged_lib.pool_bytes(
+                cfg, self.num_pages, serve.page_size, serve.kv_dtype
+            )
+            self._bt = np.zeros((n, serve.pages_per_slot), np.int32)
+            self._bt_dirty = False
+        else:
+            self.num_pages = 0
+            self.allocator = None
+            ring = gpt.init_kv_cache(cfg, n, w)
+            self.kv_bytes = sum(
+                int(np.prod(c.shape)) * c.dtype.itemsize for c in ring.values()
+            )
+            self.cache = jax.tree.map(lambda c: place(c, cache_spec), ring)
         self.cursors = place(np.zeros((n,), np.int32), slot_spec)
         self.active = place(np.zeros((n,), bool), slot_spec)
         self.limits = place(np.zeros((n,), np.int32), slot_spec)
@@ -241,11 +402,12 @@ class ServeEngine:
         self.buckets_used: set[int] = set()
         self.steps = 0
         self.admitted = 0
+        self.max_live = 0
         self.evicted = {"eos": 0, "length": 0}
         self._gen_total = 0
         self.last_summary: dict | None = None
         # per-window deltas
-        self._win = dict(steps=0, gen0=0, admit0=0, comps0=0)
+        self._win = dict(steps=0, gen0=0, admit0=0, comps0=0, hits0=0)
         self._window_idx = 0
 
     # ---- scheduling ------------------------------------------------------
@@ -310,10 +472,136 @@ class ServeEngine:
                 )
             self.buckets_used.add(bucket)
             for slot, req, plen in entries:
-                self._lanes[slot] = _Lane(req, now, plen, bucket)
+                self._lanes[slot] = _Lane(req, now, plen, bucket, active_s=now)
                 self.admitted += 1
+        self.max_live = max(self.max_live, len(self._lanes))
+
+    # ---- paged scheduling (round 15) -------------------------------------
+
+    def _admit_paged_one(self, req: Request, now: float) -> bool:
+        """Admit one request into the paged pool, or return False when the
+        pool cannot cover it yet (head-of-line admission control — pages,
+        not just lanes, are the capacity). The request's whole worst case
+        — `ceil(min(prompt + budget, width) / P)` pages — is allocated up
+        front, so decode can never starve mid-request; the savings vs the
+        ring is the footprint (actual need, not bucket width), plus every
+        shared-prefix page the registry already holds.
+
+        Prefix reuse: the registry walk is capped at `(prompt_len-1) // P`
+        (the last prompt position's page must stay private — it is
+        rewritten by the first decode tick) and aligned DOWN to the
+        prefill chunk so the remaining suffix starts on a chunk boundary.
+        Shared pages are claimed (refcounted) before the private
+        allocation so the allocator's retained-LRU reclaim can't steal
+        them in between."""
+        plen = len(req.ids)
+        if plen < 1:
+            raise ValueError(f"request {req.rid}: empty prompt")
+        bucket = self.bucket_for(plen)
+        p, c = self.serve.page_size, self.serve.chunk
+        limit = min(plen + req.max_new_tokens, self.serve.width)
+        total = -(-limit // p)
+        matched = self.allocator.lookup_prefix(req.ids, (plen - 1) // p)
+        s_tokens = (len(matched) * p // c) * c
+        shared = matched[: s_tokens // p]
+        self.allocator.claim(shared)
+        fresh = self.allocator.alloc(total - len(shared))
+        if fresh is None:
+            self.allocator.release(shared)
+            return False
+        slot = self._free.popleft()
+        pages = list(shared) + fresh
+        self._bt[slot] = 0
+        self._bt[slot, : len(pages)] = pages
+        self._bt_dirty = True
+        # prefill only the chunks that hold prompt tokens — the ring
+        # prefilled the whole bucket, but bucket-pad K/V is causally dead
+        # (never attended), so chunks past ceil(plen/chunk) would be pure
+        # padding forwards that delay decode arming and inflate admit
+        # latency. Position plen-1 always lands in the last dispatched
+        # chunk (s_tokens <= ((plen-1)//p)*p < plen <= prefill_end).
+        prefill_end = -(-plen // c) * c
+        self._lanes[slot] = _Lane(
+            req, now, plen, bucket, pages=pages, shared=len(shared),
+            next_chunk=s_tokens, prefill_end=prefill_end, phase="prefill",
+            key=np.asarray(jax.random.PRNGKey(req.seed), np.uint32),
+        )
+        self.admitted += 1
+        self.max_live = max(self.max_live, len(self._lanes))
+        self.buckets_used.add(bucket)
+        if shared:
+            self.allocator.stats.prefix_hits += 1
+            self.allocator.stats.prefix_pages_reused += len(shared)
+        return True
+
+    def _dispatch_prefill_chunks(self, now: float) -> None:
+        """Advance every prefilling lane by ONE chunk in one batched
+        dispatch (`decode.prefill_chunk_paged`), interleaved with decode
+        quanta by the run loop — the chunked-prefill contract: a long
+        prompt costs active slots at most one chunk of compute per
+        scheduler iteration, and a prefix-hit admission starts at its
+        first UNSHARED chunk (a full-prefix hit dispatches only the final
+        chunk holding the private last-prompt page). Lanes finishing
+        their last chunk arm decode state on-device and are registered
+        into the prefix registry here (host metadata; device ordering
+        guarantees the chunk's writes land before any later read)."""
+        entries = []
+        c = self.serve.chunk
+        for slot, lane in self._lanes.items():
+            if lane.phase != "prefill":
+                continue
+            start = lane.next_chunk
+            seg = lane.req.ids[start : start + c]
+            row = np.zeros((c,), np.int32)
+            row[: len(seg)] = seg
+            entries.append((slot, lane, start, row, start + c >= lane.prefill_end))
+        if not entries:
+            return
+        a = 1 << (len(entries) - 1).bit_length()  # pad to power of two
+        rows = np.zeros((a, c), np.int32)
+        slots = np.zeros((a,), np.int32)
+        starts = np.zeros((a,), np.int32)
+        last = np.zeros((a,), bool)
+        plens = np.zeros((a,), np.int32)
+        lims = np.zeros((a,), np.int32)
+        keys = np.zeros((a, 2), np.uint32)
+        for i in range(a):  # repeats are idempotent (round-14 admit trick)
+            slot, lane, start, row, is_last = entries[min(i, len(entries) - 1)]
+            rows[i], slots[i], starts[i], last[i] = row, slot, start, is_last
+            plens[i] = lane.prompt_len
+            lims[i] = min(lane.prompt_len + lane.req.max_new_tokens,
+                          self.serve.width)
+            keys[i] = lane.key
+        self._refresh_bt()
+        with self.spans.span("prefill"):
+            (self.buf, self.cache, self.cursors, self.active, self.limits,
+             self.keys) = serve_decode.prefill_chunk_paged(
+                self.params, self.cfg, self.buf, self.cache, self.cursors,
+                self.active, self.limits, self.keys,
+                self._place(slots, P()), self._place(rows, P()),
+                self._place(starts, P()), self._place(last, P()),
+                self._place(plens, P()), self._place(lims, P()),
+                self._place(keys, P()),
+            )
+        for slot, lane, start, row, is_last in entries:
+            lane.next_chunk = start + c
+            if is_last:
+                lane.phase = "decode"
+                lane.active_s = now
+                reg = (lane.prompt_len - 1) // self.serve.page_size
+                self.allocator.register(lane.req.ids, lane.pages[:reg])
+
+    def _refresh_bt(self) -> None:
+        """Push the host block tables to the device copy the programs
+        read. Tables change only at admission/eviction; between those the
+        cached device array rides along unchanged through every jit."""
+        if self._bt_dirty:
+            self.cache["bt"] = self._place(self._bt, P())
+            self._bt_dirty = False
 
     def _step(self) -> None:
+        if self.serve.paged:
+            self._refresh_bt()
         with self.spans.span("decode"):
             self.buf, self.cache, self.cursors, self.active = serve_decode.decode_step(
                 self.params, self.cfg, self.buf, self.cache, self.cursors,
@@ -331,11 +619,15 @@ class ServeEngine:
         with self.spans.span("sync"):
             cur = np.asarray(jax.device_get(self.cursors))
             act = np.asarray(jax.device_get(self.active))
-        finished = [s for s in self._lanes if not act[s]]
+        # prefilling paged lanes are act=False by design, not finished
+        finished = [
+            s for s, lane in self._lanes.items()
+            if lane.phase == "decode" and not act[s]
+        ]
         gen_live = sum(
             int(cur[s]) - lane.prompt_len
             for s, lane in self._lanes.items()
-            if s not in finished
+            if lane.phase == "decode" and s not in finished
         )
         if finished:
             host_buf = np.asarray(jax.device_get(self.buf))
@@ -343,6 +635,15 @@ class ServeEngine:
                 lane = self._lanes.pop(s)
                 length = int(cur[s])
                 generated = length - lane.prompt_len
+                ids = host_buf[s, :length].copy()
+                if self.serve.paged:
+                    # a prefix-hit admission SKIPS its shared chunks, so the
+                    # buffer row never received those prompt tokens (their
+                    # K/V lives in the shared pages; decode never reads buf
+                    # below prompt_len-1, which is always in a dispatched
+                    # chunk) — the completion's prompt comes from the
+                    # request itself
+                    ids[: lane.prompt_len] = lane.req.ids
                 reason = (
                     "length"
                     if length >= min(lane.prompt_len + lane.req.max_new_tokens,
@@ -351,11 +652,22 @@ class ServeEngine:
                 )
                 self.evicted[reason] += 1
                 self.completions.append(Completion(
-                    rid=lane.req.rid, ids=host_buf[s, :length].copy(),
+                    rid=lane.req.rid, ids=ids,
                     prompt_len=lane.prompt_len, generated=generated,
                     reason=reason, arrival_s=lane.req.arrival_s,
                     admit_s=lane.admit_s, done_s=now,
+                    pages=len(lane.pages), prefix_pages=lane.shared,
+                    active_s=lane.active_s or lane.admit_s,
                 ))
+                if self.serve.paged:
+                    # drop this lane's references: private pages free (or
+                    # retire into the prefix LRU if registered), shared
+                    # pages survive for their other readers — and zero the
+                    # block-table row so any stale in-flight write lands
+                    # in the null page, never in a re-issued one
+                    self.allocator.release(lane.pages)
+                    self._bt[s] = 0
+                    self._bt_dirty = True
                 self._free.append(s)
         self._gen_total = sum(c.generated for c in self.completions) + gen_live
 
@@ -383,6 +695,18 @@ class ServeEngine:
             p50_token_s=_pct([c.per_token_s for c in comps], 50),
             p99_token_s=_pct([c.per_token_s for c in comps], 99),
         )
+        if self.serve.paged:
+            # the paged health triple (round 15): pool pressure, how much
+            # admission work prefix reuse is deleting, and the per-request
+            # footprint the ring design couldn't see
+            hits = self.allocator.stats.prefix_hits - self._win["hits0"]
+            rec["page_occupancy"] = self.allocator.occupancy
+            rec["prefix_hit_rate"] = (
+                hits / rec["admitted"] if rec["admitted"] else None
+            )
+            rec["pages_per_request"] = (
+                float(np.mean([c.pages for c in comps])) if comps else None
+            )
         if self.logger is not None:
             self.logger.log(**rec)
         if self.recorder is not None:
@@ -395,6 +719,7 @@ class ServeEngine:
         self._win = dict(
             steps=0, gen0=self._gen_total, admit0=self.admitted,
             comps0=len(self.completions),
+            hits0=self.allocator.stats.prefix_hits if self.serve.paged else 0,
         )
 
     def summary(self, wall_s: float) -> dict:
@@ -421,6 +746,25 @@ class ServeEngine:
         rec["prefill_s"] = ep["seconds"].get("prefill", 0.0)
         rec["decode_s"] = ep["seconds"].get("decode", 0.0)
         rec["sync_s"] = ep["seconds"].get("sync", 0.0)
+        rec["max_live_slots"] = self.max_live
+        rec["kv_bytes"] = self.kv_bytes
+        if self.serve.paged:
+            st = self.allocator.stats
+            hit = [c.admit_latency_s for c in comps if c.prefix_pages > 0]
+            cold = [c.admit_latency_s for c in comps if c.prefix_pages == 0]
+            rec.update(
+                page_size=self.serve.page_size, num_pages=self.num_pages,
+                kv_dtype=self.serve.kv_dtype,
+                prefix_hits=st.prefix_hits,
+                prefix_hit_rate=st.prefix_hits / max(self.admitted, 1),
+                prefix_pages_reused=st.prefix_pages_reused,
+                reclaimed_pages=st.reclaimed,
+                page_occupancy=self.allocator.occupancy,
+                pages_per_request=float(np.mean([c.pages for c in comps]))
+                if comps else None,
+                admit_latency_hit_s=float(np.mean(hit)) if hit else None,
+                admit_latency_cold_s=float(np.mean(cold)) if cold else None,
+            )
         return rec
 
     # ---- the loop --------------------------------------------------------
@@ -443,17 +787,29 @@ class ServeEngine:
                     f"serve run exceeded max_wall_s={max_wall_s} with "
                     f"{len(self._pending)} pending / {len(self._lanes)} live"
                 )
-            ready: list[Request] = []
-            while (self._pending and len(ready) < len(self._free)
-                   and self._pending[0].arrival_s <= now):
-                ready.append(self._pending.popleft())
-            if ready:
-                self._admit_batch(ready, now)
-            if not self._lanes:
-                # nothing decoding and the next arrival is in the future
-                wait = self._pending[0].arrival_s - now
-                if wait > 0:
-                    time.sleep(min(wait, 0.05))
+            if self.serve.paged:
+                # page-aware admission control: a request needs a free lane
+                # AND its worst-case page footprint; the head of the queue
+                # waits (FIFO, no starvation) when the pool can't cover it
+                while (self._pending and self._free
+                       and self._pending[0].arrival_s <= now):
+                    if not self._admit_paged_one(self._pending[0], now):
+                        break
+                    self._pending.popleft()
+                self._dispatch_prefill_chunks(time.perf_counter() - t0)
+            else:
+                ready: list[Request] = []
+                while (self._pending and len(ready) < len(self._free)
+                       and self._pending[0].arrival_s <= now):
+                    ready.append(self._pending.popleft())
+                if ready:
+                    self._admit_batch(ready, now)
+            if not any(l.phase == "decode" for l in self._lanes.values()):
+                if not self._lanes and self._pending:
+                    # nothing decoding and the next arrival is in the future
+                    wait = self._pending[0].arrival_s - now
+                    if wait > 0:
+                        time.sleep(min(wait, 0.05))
                 continue
             self._step()
             self._sync_evict(time.perf_counter() - t0)
@@ -477,7 +833,8 @@ class ServeEngine:
 def synthetic_request_stream(tokenizer, n: int, *, seed: int = 0,
                              max_new_tokens: int = 16,
                              buckets=(16, 32), qps: float = 0.0,
-                             corpus=None, lengths=None) -> list[Request]:
+                             corpus=None, lengths=None,
+                             shared_prefix: int = 0) -> list[Request]:
     """Seeded synthetic request stream: prompts cut from the offline
     fixture corpus at seeded lengths spanning the bucket set, arrivals
     all-at-once (qps=0, an offered-load saturation test) or spaced by a
@@ -485,11 +842,22 @@ def synthetic_request_stream(tokenizer, n: int, *, seed: int = 0,
     serving bench compares continuous vs serial on the SAME stream.
     `lengths` restricts the drawn prompt lengths to a fixed set (the
     bench uses it so the SERIAL baseline's per-prompt-length compiles
-    stay bounded; the engine is bucket-bounded either way)."""
+    stay bounded; the engine is bucket-bounded either way).
+
+    `shared_prefix > 0` prepends the SAME `shared_prefix`-token system
+    prompt (cut from the corpus head) to every request — the
+    millions-of-users-one-system-prompt shape that paged prefix reuse
+    (round 15) exists for. Bodies stay per-request; combined prompts are
+    truncated to the largest bucket."""
     from tpukit.data import synthetic_stories
 
     rng = np.random.RandomState(seed)
     corpus = corpus if corpus is not None else synthetic_stories(max(64, n))
+    prefix: list[int] = []
+    if shared_prefix > 0:
+        prefix = list(tokenizer(
+            [" ".join(corpus)], truncation=True, max_length=shared_prefix
+        )["input_ids"][0])
     out = []
     t = 0.0
     for i in range(n):
@@ -499,6 +867,7 @@ def synthetic_request_stream(tokenizer, n: int, *, seed: int = 0,
         else:
             target = int(rng.randint(4, max(buckets) + 1))
         ids = tokenizer([text], truncation=True, max_length=target)["input_ids"][0]
+        ids = (prefix + list(ids))[: max(buckets)]
         if qps > 0:
             t += float(rng.exponential(1.0 / qps))
         out.append(Request(

@@ -1,0 +1,410 @@
+"""Paged KV cache: fixed-size pages + per-slot block tables (round 15).
+
+ROADMAP open item 2. The round-14 serving engine preallocates every decode
+lane at the full KV-ring width — a 20-token answer in a wide slot strands
+almost all of its KV HBM, and the worst-case request sets the slot count
+(i.e. the throughput ceiling) for everyone. This module replaces the
+per-slot ring with the layout real serving engines use (vLLM's
+PagedAttention, PAPERS.md):
+
+  - **Page pool**: one `[L, num_pages, H, P, D]` K buffer and one V buffer
+    (P = `page_size` token positions per page). Page 0 is the reserved
+    NULL page — never allocated, the sink for masked writes — so a block
+    table full of zeros is always safe to dereference.
+  - **Block tables**: per-slot `[N, pages_per_slot]` int32 rows of page
+    ids. The decode step dereferences them with ONE gather per layer
+    (`gather_view`) into exactly the `[N, H, W, D]` per-row view the
+    round-14 vector-cursor attention already consumes — the indirection is
+    localized in `gpt._apply_attention_cached`'s paged branch and the
+    decode-step math is otherwise byte-for-byte the ring path, which is
+    what keeps the token-for-token parity bar provable.
+  - **Allocation at request granularity**: a request admitted with prompt
+    length p and budget m holds `ceil(min(p + m, width) / P)` pages — its
+    actual worst case — instead of a full-width slot. The HBM a short
+    answer strands is at most one page, and the pool (not the widest
+    request) sets the concurrency ceiling.
+  - **Shared-prefix reuse**: prompt prefixes are hashed at page
+    granularity into a chained registry (parent-page + chunk-tokens ->
+    page). A new request walks the registry, points its block table at
+    the matched read-only pages with refcounts, and skips the shared
+    portion of prefill entirely. Refcount-0 registered pages are RETAINED
+    (LRU) and reclaimed only under pool pressure, so a popular system
+    prompt stays hot across non-overlapping requests.
+  - **int8 page payloads** (`kv_dtype="int8"`): page rows quantized with
+    `ops.quant_comm`'s per-256-element block quantizer (EQuARX layout,
+    round 12) — one f32 scale per 256 elements of the flattened
+    `[P, D]` row per head, payload int8 — for ~4x pages per HBM byte vs
+    f32 (~2x vs bf16). Quantization is lossy by construction, so int8 KV
+    is gated by a token-level tolerance test (tests/test_paged.py),
+    mirroring the round-12 loss-trajectory gate; f32/bf16 page storage at
+    the matching compute dtype stays token-for-token exact.
+
+Write-safety invariants (everything here leans on them):
+
+  1. A slot's WRITABLE pages are exclusively owned. Shared (registered)
+     pages are capped at `(prompt_len - 1) // P` — the page holding
+     position `prompt_len - 1` is always private, because the first
+     decode tick re-forwards the last prompt token and rewrites that
+     position's K/V (identical values, but a write nonetheless — and
+     under int8 a block REQUANTIZATION, which must never touch a page
+     another slot reads).
+  2. Masked rows (inactive/free slots, padded admit lanes) write to page
+     0. The engine zeroes a freed slot's block-table row, so even a stale
+     in-flight write after eviction lands in the null page, never in a
+     page the allocator has re-issued.
+  3. Reads beyond a slot's logical cursor hit garbage (the null page, an
+     unwritten tail, a recycled page's old contents) — and are masked by
+     the causal `key_pos <= q_pos` window exactly like the ring path's
+     stale-tail garbage, which softmax turns into exact zeros. Same
+     argument, same tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict, deque
+
+import jax
+import jax.numpy as jnp
+
+from tpukit.ops import quant_comm
+
+KV_DTYPES = ("f32", "bf16", "int8")
+
+_STORAGE = {"f32": jnp.float32, "bf16": jnp.bfloat16}
+
+
+def storage_dtype(kv_dtype: str):
+    """jnp storage dtype of a non-quantized page pool."""
+    if kv_dtype not in _STORAGE:
+        raise ValueError(f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
+    return _STORAGE[kv_dtype]
+
+
+def validate_kv_layout(cfg, page_size: int, kv_dtype: str,
+                       block: int = quant_comm.DEFAULT_BLOCK) -> None:
+    """Named construction-time rejection of layouts that would otherwise
+    surface as opaque XLA shape errors deep inside the quantizer: int8
+    pages quantize each head's flattened `[P, D]` row in `block`-element
+    blocks, so the row must tile exactly."""
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
+    if kv_dtype == "int8":
+        row = page_size * cfg.head_dim
+        if row % block:
+            raise ValueError(
+                f"kv_dtype=int8 requires the page payload per head "
+                f"(page_size {page_size} x head_dim {cfg.head_dim} = {row} "
+                f"elements) to be a multiple of quant_comm's {block}-element "
+                f"quant block — use a page size that tiles into {block}s "
+                f"(e.g. page_size {-(-block // cfg.head_dim)})"
+            )
+
+
+def scale_blocks(cfg, page_size: int, block: int = quant_comm.DEFAULT_BLOCK) -> int:
+    """f32 scales per (page, head) row of an int8 pool."""
+    return (page_size * cfg.head_dim) // block
+
+
+def init_paged_cache(cfg, num_pages: int, page_size: int, pages_per_slot: int,
+                     slots: int, kv_dtype: str = "f32") -> dict:
+    """The paged-cache pytree the serve programs thread: K/V pools
+    `[L, num_pages, H, P, D]` (int8 adds per-row scale sidecars
+    `[L, num_pages, H, blocks]`) plus the block tables `[N, pages_per_slot]`
+    (all zeros = every slot dereferences the null page)."""
+    validate_kv_layout(cfg, page_size, kv_dtype)
+    shape = (cfg.num_layers, num_pages, cfg.heads, page_size, cfg.head_dim)
+    bt = jnp.zeros((slots, pages_per_slot), jnp.int32)
+    if kv_dtype == "int8":
+        nb = scale_blocks(cfg, page_size)
+        sshape = (cfg.num_layers, num_pages, cfg.heads, nb)
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "ks": jnp.zeros(sshape, jnp.float32),
+            "vs": jnp.zeros(sshape, jnp.float32),
+            "bt": bt,
+        }
+    dt = storage_dtype(kv_dtype)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt), "bt": bt}
+
+
+def pool_bytes(cfg, num_pages: int, page_size: int, kv_dtype: str) -> int:
+    """Closed-form HBM bytes of the K+V pools (the equal-HBM bench math:
+    int8 pays 1 byte per element plus the 4-byte-per-block f32 scale
+    sidecar, i.e. `packed_bytes` per (page, head) row)."""
+    per_head_row = page_size * cfg.head_dim
+    if kv_dtype == "int8":
+        row_bytes = quant_comm.packed_bytes(per_head_row)
+    else:
+        row_bytes = per_head_row * jnp.dtype(storage_dtype(kv_dtype)).itemsize
+    return 2 * cfg.num_layers * num_pages * cfg.heads * row_bytes
+
+
+# -- device-side page ops (called per layer from gpt.forward_cached) --------
+
+
+def gather_view(pool, scales, bt, out_dtype):
+    """Dereference the block tables: `pool [NP, H, P, D]` gathered through
+    `bt [N, MP]` into the `[N, H, MP*P, D]` per-row K (or V) view the
+    round-14 vector-cursor attention consumes. Logical position `q` of row
+    `b` lives at `view[b, :, q, :]` == page `bt[b, q // P]`, offset
+    `q % P` — the ONE indirection of the paged design. int8 pools
+    dequantize after the gather (per-row blocks, `quant_comm` layout)."""
+    v = pool[bt]  # [N, MP, H, P, D] — gather on the (unsharded) page axis
+    n, mp, h, p, d = v.shape
+    if scales is not None:
+        # dequantize with the head axis PRESERVED (the pools shard heads
+        # over `model`; merging H into a rows axis would force a GSPMD
+        # reshard — the comm-free audit would break)
+        s = scales[bt]  # [N, MP, H, blocks]
+        v = quant_comm.dequantize_blocks(
+            v.reshape(n, mp, h, p * d), s
+        ).reshape(n, mp, h, p, d)
+    return v.astype(out_dtype).transpose(0, 2, 1, 3, 4).reshape(n, h, mp * p, d)
+
+
+def write_token(pool, scales, bt, start, val, write_mask):
+    """Decode-tick write-back: row `b`'s freshly computed K (or V)
+    `val [N, H, D]` lands at logical position `start[b]` — page
+    `bt[b, start // P]`, offset `start % P`. Rows with `write_mask`
+    False are routed to the null page (invariant 2 above): an inactive or
+    prefilling slot's re-forward must never touch a real page.
+
+    f32/bf16 pools scatter the single position; int8 pools gather the
+    touched page row, dequantize, insert the exact new value, and
+    REQUANTIZE the row (the block scale may move — which is why shared
+    pages are never writable, invariant 1). Writable pages are exclusive
+    per slot, so the scatter's row indices never collide except on the
+    null page, where any winner is garbage by design."""
+    n = start.shape[0]
+    p = pool.shape[2]
+    page = start // p
+    off = start % p
+    pids = jnp.take_along_axis(bt, page[:, None], axis=1)[:, 0]
+    pids = jnp.where(write_mask, pids, 0)
+    if scales is None:
+        return pool.at[pids, :, off, :].set(val.astype(pool.dtype)), None
+    h, d = pool.shape[1], pool.shape[3]
+    rows = pool[pids]  # [N, H, P, D] int8
+    srows = scales[pids]  # [N, H, blocks]
+    # head axis preserved through the quantizer (sharding — gather_view)
+    deq = quant_comm.dequantize_blocks(
+        rows.reshape(n, h, p * d), srows
+    ).reshape(n, h, p, d)
+    hit = jax.lax.broadcasted_iota(jnp.int32, (n, 1, p, 1), 2) == off[:, None, None, None]
+    deq = jnp.where(hit, val[:, :, None, :].astype(jnp.float32), deq)
+    q, s = quant_comm.quantize_blocks(deq.reshape(n, h, p * d))
+    return (
+        pool.at[pids].set(q.reshape(n, h, p, d)),
+        scales.at[pids].set(s),
+    )
+
+
+def write_pages(pool, scales, bt, start, vals, write_mask):
+    """Prefill-chunk write-back: `vals [N, H, C, D]` covers logical
+    positions `[start[b], start[b] + C)` per row, with `start` page-aligned
+    and C a page multiple (the engine's chunking contract) — so the write
+    is whole pages, one scatter row per (lane, chunk-page). Masked lanes
+    route to the null page. Chunk positions beyond a lane's allocation
+    dereference block-table zeros and also land in the null page —
+    bucket-pad garbage never occupies a real page."""
+    n, h, c, d = vals.shape
+    p = pool.shape[2]
+    npg = c // p
+    first = start // p
+    j = jnp.arange(npg, dtype=start.dtype)
+    pids = jnp.take_along_axis(bt, first[:, None] + j[None, :], axis=1)  # [N, npg]
+    pids = jnp.where(write_mask[:, None], pids, 0).reshape(-1)
+    rows = (
+        vals.reshape(n, h, npg, p, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(n * npg, h, p, d)
+    )
+    if scales is None:
+        return pool.at[pids].set(rows.astype(pool.dtype)), None
+    q, s = quant_comm.quantize_blocks(  # head axis preserved (sharding)
+        rows.astype(jnp.float32).reshape(n * npg, h, p * d)
+    )
+    return (
+        pool.at[pids].set(q.reshape(n * npg, h, p, d)),
+        scales.at[pids].set(s),
+    )
+
+
+# -- host-side page allocator + shared-prefix registry ----------------------
+
+
+@dataclasses.dataclass
+class PageStats:
+    """Counters the engine folds into its serve windows."""
+
+    prefix_hits: int = 0
+    prefix_pages_reused: int = 0
+    prefix_lookups: int = 0
+    reclaimed: int = 0
+
+
+class PageAllocator:
+    """Host-side bookkeeping for the page pool: a free list over pages
+    `1..num_pages-1` (0 is the null page), per-page refcounts, and the
+    shared-prefix registry.
+
+    The registry is a radix-style chain keyed by `(parent_page_id,
+    chunk_tokens)` — a page is reachable only through its registered
+    parent, so matching is exact (token tuples, no hash collisions) and a
+    freed parent automatically orphans its subtree (which is purged, so a
+    reallocated page id can never be matched under stale content).
+    Registered pages whose refcount drops to 0 are RETAINED in an LRU and
+    reclaimed only when an allocation would otherwise fail — a popular
+    prefix survives gaps between requests."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages={num_pages} must be >= 2 (page 0 is the "
+                f"reserved null page)"
+            )
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free = deque(range(1, num_pages))
+        self.refcount = [0] * num_pages
+        self._registry: dict[tuple, int] = {}  # (parent, chunk) -> page
+        self._key_of: dict[int, tuple] = {}  # page -> its registry key
+        self._parent: dict[int, int] = {}  # page -> parent page (0 = root)
+        self._children: dict[int, set] = {}  # page -> registered children
+        self._retained: OrderedDict[int, None] = OrderedDict()  # refcount-0 LRU
+        self.stats = PageStats()
+
+    # ---- accounting ----
+
+    @property
+    def free_pages(self) -> int:
+        """Pages allocatable WITHOUT evicting retained prefix pages."""
+        return len(self._free)
+
+    @property
+    def available_pages(self) -> int:
+        """Pages an `alloc` could produce (free + reclaimable retained)."""
+        return len(self._free) + len(self._retained)
+
+    @property
+    def live_pages(self) -> int:
+        """Pages referenced by at least one slot."""
+        return (self.num_pages - 1) - len(self._free) - len(self._retained)
+
+    @property
+    def occupancy(self) -> float:
+        """Live fraction of the allocatable pool."""
+        return self.live_pages / max(self.num_pages - 1, 1)
+
+    # ---- allocate / release ----
+
+    def alloc(self, n: int) -> list[int] | None:
+        """`n` exclusive pages (refcount 1 each), or None if the pool
+        cannot cover them even after reclaiming retained prefix pages
+        (LRU order) — the admission-control signal. Feasibility is
+        checked BEFORE any reclaim: a doomed allocation must not purge
+        the retained prefix registry on its way to failing (the caller
+        retries the same admission next iteration, and every hit it
+        would have had is gone)."""
+        if len(self._free) + len(self._retained) < n:
+            return None
+        while len(self._free) < n and self._retained:
+            self._purge(next(iter(self._retained)))
+            self.stats.reclaimed += 1
+        if len(self._free) < n:
+            return None
+        out = [self._free.popleft() for _ in range(n)]
+        for p in out:
+            self.refcount[p] = 1
+        return out
+
+    def claim(self, pages: list[int]) -> None:
+        """Take a reader reference on shared pages (a prefix hit). A
+        retained page comes back live."""
+        for p in pages:
+            if p in self._retained:
+                del self._retained[p]
+            self.refcount[p] += 1
+
+    def release(self, pages: list[int]) -> None:
+        """Drop one reference per page (eviction). A registered page at
+        refcount 0 is retained for future prefix hits; an unregistered one
+        returns to the free list."""
+        for p in pages:
+            if p <= 0:
+                continue
+            self.refcount[p] -= 1
+            if self.refcount[p] < 0:
+                raise AssertionError(f"page {p} refcount went negative")
+            if self.refcount[p] == 0:
+                if p in self._key_of:
+                    self._retained[p] = None
+                else:
+                    self._free.append(p)
+
+    def _purge(self, pid: int) -> None:
+        """Remove `pid`'s registration (and its whole registered subtree —
+        children are only reachable through the parent). Retained pages in
+        the subtree return to the free list; live ones just lose their
+        registration and free normally at their last release."""
+        key = self._key_of.pop(pid, None)
+        if key is not None:
+            self._registry.pop(key, None)
+        parent = self._parent.pop(pid, None)
+        if parent is not None and parent in self._children:
+            self._children[parent].discard(pid)
+        if pid in self._retained:
+            del self._retained[pid]
+            self._free.append(pid)
+        for child in list(self._children.pop(pid, ())):
+            self._purge(child)
+
+    # ---- shared-prefix registry ----
+
+    def _chunk(self, ids, i: int) -> tuple:
+        p = self.page_size
+        return tuple(int(t) for t in ids[i * p : (i + 1) * p])
+
+    def lookup_prefix(self, ids, max_pages: int) -> list[int]:
+        """Longest registered chain matching `ids` at page granularity,
+        capped at `max_pages` (the caller passes `(prompt_len - 1) // P` —
+        invariant 1: the page holding the last prompt position must stay
+        private). Returned pages are NOT yet claimed."""
+        self.stats.prefix_lookups += 1
+        out: list[int] = []
+        parent = 0
+        for i in range(max_pages):
+            pid = self._registry.get((parent, self._chunk(ids, i)))
+            if pid is None:
+                break
+            out.append(pid)
+            parent = pid
+        return out
+
+    def register(self, ids, pages: list[int]) -> None:
+        """Publish `pages[i] = K/V of ids[i*P:(i+1)*P]` into the registry
+        (called once a slot's prefill completes — the pages are final and
+        read-only from here on). Already-registered chunks keep their
+        first registration; our duplicate page stays private and frees
+        normally, while deeper chunks chain from the canonical page so one
+        popular prefix converges to one chain."""
+        parent = 0
+        for i, pid in enumerate(pages):
+            key = (parent, self._chunk(ids, i))
+            existing = self._registry.get(key)
+            if existing is not None:
+                parent = existing
+                continue
+            if pid in self._key_of:  # already published under another chain
+                parent = pid
+                continue
+            self._registry[key] = pid
+            self._key_of[pid] = key
+            self._parent[pid] = parent
+            self._children.setdefault(parent, set()).add(pid)
+            parent = pid
+
+    def registered_pages(self) -> int:
+        return len(self._key_of)

@@ -235,11 +235,7 @@ def bench_moe_ep_comm(cfg, n_dev, num_experts=8, steps=8):
 
     from tools.bench_ladder import make_batch, setup_step, time_windows
     from tpukit.mesh import create_mesh
-    from tpukit.obs import (
-        capture_compiler_stderr,
-        collective_bytes,
-        count_involuntary_remat,
-    )
+    from tpukit.obs import capture_compiler_stderr, collective_bytes
     from tpukit.shardings import ExpertParallel
 
     expert = math.gcd(n_dev, num_experts)
@@ -283,7 +279,7 @@ def bench_moe_ep_comm(cfg, n_dev, num_experts=8, steps=8):
         "expected_a2a": {"count": expected["count"], "bytes": expected["bytes"]},
         "measured_a2a": measured,
         "bytes_match": bytes_match,
-        "involuntary_remat_warnings": count_involuntary_remat(cap["text"]),
+        "involuntary_remat_warnings": cap["involuntary_remat"],
         "tokens_per_sec_per_chip": round(steps * batch * (seq - 1) / min(times) / n_dev, 1),
         "final_loss": round(loss, 6),
     }
@@ -714,7 +710,6 @@ def bench_quant_comm(cfg, n_dev, num_experts=8, steps=8):
     from tpukit.obs import (
         capture_compiler_stderr,
         collective_bytes,
-        count_involuntary_remat,
         wire_bytes,
     )
     from tpukit.shardings import DataParallel, ExpertParallel, FSDP
@@ -798,9 +793,7 @@ def bench_quant_comm(cfg, n_dev, num_experts=8, steps=8):
                         if coll.get(op)
                     } or None,
                     "bytes_match": exact,
-                    "involuntary_remat_warnings": count_involuntary_remat(
-                        cap["text"]
-                    ),
+                    "involuntary_remat_warnings": cap["involuntary_remat"],
                     "tokens_per_sec_per_chip": round(
                         steps * batch * (seq - 1) / min(times) / n_dev, 1
                     ),

@@ -407,9 +407,7 @@ def test_ep_a2a_hlo_audit(cfg, batch, dispatch):
     through one audit asserts the round-11 kernel path changed the
     on-device FFN spelling without touching the collective schedule — the
     "unchanged a2a byte audit" acceptance bar."""
-    from tpukit.obs.xla import (
-        capture_compiler_stderr, collective_bytes, count_involuntary_remat,
-    )
+    from tpukit.obs.xla import capture_compiler_stderr, collective_bytes
 
     model_batch, targets = batch
     strategy = ExpertParallel(create_mesh({"data": 2, "expert": 4}), dispatch=dispatch)
@@ -418,11 +416,12 @@ def test_ep_a2a_hlo_audit(cfg, batch, dispatch):
     shapes = jax.eval_shape(lambda: state)
     struct = lambda x: jax.ShapeDtypeStruct(np.asarray(x).shape, np.asarray(x).dtype)  # noqa: E731
     b_structs = jax.tree.map(struct, model_batch)
-    with capture_compiler_stderr() as cap:
+    # check=True: the capture itself raises on any involuntary-remat
+    # warning (one spelling of the capture-then-count pattern, round 16)
+    with capture_compiler_stderr(check=True) as cap:
         train_step, eval_step, _ = make_step_fns(cfg, opt, strategy, shapes)
         compiled = train_step.lower(shapes, b_structs, struct(targets)).compile()
         ecompiled = eval_step.lower(shapes, b_structs, struct(targets)).compile()
-    assert count_involuntary_remat(cap["text"]) == 0, cap["text"][-2000:]
 
     expect = strategy.dispatch_comm(cfg, global_batch=BATCH, seq=SEQ)
     a2a = collective_bytes(compiled.as_text()).get("all-to-all")

@@ -568,6 +568,19 @@ def test_fit_emits_xla_analysis_once_per_compile(telemetry_run):
     assert train_rec["collectives"] == {}  # single device: no comm
 
 
+def test_fit_xla_records_carry_hlolint_verdict(telemetry_run):
+    """Round 16: every xla record carries the rule-engine summary
+    (tpukit/analysis) — on the single-device world the verdict is clean
+    (donated state aliases, no collectives, no async pairs)."""
+    _, _, records, _, _ = telemetry_run
+    xla = [r for r in records if r["kind"] == "xla"]
+    for r in xla:
+        verdict = r.get("hlolint")
+        assert verdict is not None, r["fn"]
+        assert verdict["clean"] is True, (r["fn"], verdict)
+        assert verdict["errors"] == 0
+
+
 def test_fit_emits_epoch_and_validation_records(telemetry_run):
     _, _, records, _, _ = telemetry_run
     ep = next(r for r in records if r["kind"] == "epoch")

@@ -472,11 +472,7 @@ def test_tp_paged_decode_step_hlo_comm_audit(kv_dtype, temperature, top_k):
     quantize/dequantize all COMM-FREE, and zero GSPMD involuntary-remat
     fallbacks. f32 compute so byte counts are exact on the CPU wire."""
     from tpukit.mesh import create_mesh
-    from tpukit.obs.xla import (
-        capture_compiler_stderr,
-        collective_bytes,
-        count_involuntary_remat,
-    )
+    from tpukit.obs.xla import capture_compiler_stderr, collective_bytes
 
     head_dim = 32 if kv_dtype == "int8" else 8  # int8: page*head_dim == 256
     cfg = GPTConfig(
@@ -487,7 +483,8 @@ def test_tp_paged_decode_step_hlo_comm_audit(kv_dtype, temperature, top_k):
     slots = 4
     state = _tp_paged_state(cfg, mesh, slots, kv_dtype)
     params, buf, cache, cursors, active, limits, keys = state
-    with capture_compiler_stderr() as cap:
+    # check=True raises on any involuntary-remat warning at capture exit
+    with capture_compiler_stderr(check=True):
         compiled = decode_step.lower(
             params, cfg, buf, cache, cursors, active, limits, keys,
             1, temperature, top_k, mesh,
@@ -495,7 +492,6 @@ def test_tp_paged_decode_step_hlo_comm_audit(kv_dtype, temperature, top_k):
     measured = collective_bytes(compiled.as_text())
     expected = decode_step_comm(cfg, mesh, slots, top_k=top_k, paged=True)
     assert measured == expected, (measured, expected)
-    assert count_involuntary_remat(cap["text"]) == 0, cap["text"][-2000:]
 
 
 def test_tp_paged_engine_decode_parity(tok, cfg, params):

@@ -28,7 +28,6 @@ from tpukit.model import GPTConfig
 from tpukit.obs.xla import (
     capture_compiler_stderr,
     collective_bytes,
-    count_involuntary_remat,
     wire_bytes,
 )
 from tpukit.ops import quant_comm as qc
@@ -121,7 +120,7 @@ def _world(kind: str, comm_dtype: str) -> dict:
         "losses": losses,
         "coll": collective_bytes(compiled.as_text()),
         "ecoll": collective_bytes(ecompiled.as_text()),
-        "warns": count_involuntary_remat(cap["text"]),
+        "warns": cap["involuntary_remat"],
     }
     return _WORLDS[key]
 

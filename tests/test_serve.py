@@ -302,11 +302,7 @@ def test_tp_decode_step_hlo_comm_audit(axes, slots, temperature, top_k):
     else, and zero GSPMD involuntary-remat fallbacks. f32 compute so the
     byte counts are exact on the CPU wire (round-12 lesson)."""
     from tpukit.mesh import create_mesh
-    from tpukit.obs.xla import (
-        capture_compiler_stderr,
-        collective_bytes,
-        count_involuntary_remat,
-    )
+    from tpukit.obs.xla import capture_compiler_stderr, collective_bytes
 
     cfg = GPTConfig(
         dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=160,
@@ -316,7 +312,8 @@ def test_tp_decode_step_hlo_comm_audit(axes, slots, temperature, top_k):
     params, buf, cache, cursors, active, limits, keys = _tp_decode_state(
         cfg, mesh, slots, width=24
     )
-    with capture_compiler_stderr() as cap:
+    # check=True raises on any involuntary-remat warning at capture exit
+    with capture_compiler_stderr(check=True):
         compiled = decode_step.lower(
             params, cfg, buf, cache, cursors, active, limits, keys,
             1, temperature, top_k, mesh,
@@ -324,7 +321,6 @@ def test_tp_decode_step_hlo_comm_audit(axes, slots, temperature, top_k):
     measured = collective_bytes(compiled.as_text())
     expected = decode_step_comm(cfg, mesh, slots, top_k=top_k)
     assert measured == expected, (measured, expected)
-    assert count_involuntary_remat(cap["text"]) == 0, cap["text"][-2000:]
 
 
 def test_tp_engine_decode_parity(tok, cfg, params):

@@ -1,0 +1,449 @@
+#!/usr/bin/env python
+"""hlolint — lint compiled HLO against the repo's comm plans and rules.
+
+Two modes:
+
+  - `--world N` (the CI lane): compile the audited worlds on N virtual
+    CPU devices — the dryrun's strategy set (DDP/FSDP f32+int8, the EP
+    a2a dispatch f32+int8) plus the serving decode steps (TP ring,
+    paged) — and run the full rule engine (tpukit/analysis/rules.py)
+    over each: CommPlan diff, involuntary-remat, s32-index-plumbing,
+    wire-upcast, donation-dropped, overlap. Any "error" finding exits 1.
+  - `--hlo FILE [FILE...]`: lint saved HLO text (plain or .gz — the
+    golden fixtures under tests/fixtures/hlo/). When a fixture's JSON
+    sidecar sits next to the file, its recorded CommPlan and donation
+    expectation are restored so the saved text gets the same audit the
+    live world does; a bare dump lints rules-only. `--stderr FILE`
+    supplies a captured compiler log for the involuntary-remat rule.
+
+Findings are emitted as `kind="hlolint"` JSONL (stdout, or `--out`),
+the schema tools/report.py renders in its `== xla ==` section
+(DESIGN.md §6/§15).
+
+`--save-hlo DIR` (with `--world`) regenerates the golden fixtures:
+gzipped module text + a JSON sidecar recording the world name, comm
+dtype, donated-leaf count, measured collectives and the compiler-stderr
+remat count — the provenance tests/test_analysis.py checks against.
+
+The world registry here is importable (`from tools.hlolint import
+WORLDS, build_world`) so the fixture tests and this CLI share ONE
+spelling of each audited world.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import os
+import sys
+from pathlib import Path
+
+# runnable as `python tools/hlolint.py` from anywhere: the repo root (one
+# up from tools/) must be importable for tpukit.analysis
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _ensure_env(n_devices: int) -> None:
+    """Force a CPU platform with n virtual devices BEFORE jax imports —
+    tools run standalone, outside conftest."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        )
+
+
+# -- the audited worlds -----------------------------------------------------
+# One spelling: the CLI lane, the fixture capture and the fixture tests all
+# build these through build_world(). Shapes are the multichip dryrun's
+# (__graft_entry__.py) for the train worlds and the serve HLO-audit tests'
+# for the decode worlds.
+
+WORLDS = (
+    "ddp_f32", "ddp_int8", "fsdp_f32", "fsdp_int8",
+    "ep_a2a", "ep_int8", "tp_decode", "paged_decode",
+)
+
+# the golden-fixture subset checked into tests/fixtures/hlo/ (ISSUE 12);
+# ep_int8 compiles the most expensive world twice for little fixture value
+FIXTURE_WORLDS = (
+    "ddp_f32", "ddp_int8", "fsdp_f32", "fsdp_int8",
+    "ep_a2a", "tp_decode", "paged_decode",
+)
+
+
+def _dryrun_cfg(comm_dtype="f32", num_experts=0):
+    import jax.numpy as jnp
+
+    from tpukit.model import GPTConfig
+
+    return GPTConfig(
+        dim=64, head_dim=16, heads=8, num_layers=4, vocab_size=128,
+        max_position_embeddings=32, compute_dtype=jnp.float32,
+        comm_dtype=comm_dtype, num_experts=num_experts,
+    )
+
+
+def _train_world(name: str, n_devices: int) -> dict:
+    import numpy as np
+
+    import jax
+
+    from tpukit.analysis import train_comm_plan
+    from tpukit.mesh import create_mesh
+    from tpukit.obs.xla import capture_compiler_stderr
+    from tpukit.shardings import FSDP, DataParallel, ExpertParallel
+    from tpukit.train import create_train_state, make_optimizer, make_step_fns
+
+    devices = jax.devices()[:n_devices]
+    inner = next((s for s in (4, 2) if n_devices % s == 0), 1)
+    if name.startswith("ep"):
+        if inner <= 1:
+            raise SystemExit(f"world {name} needs a composite device count")
+        cfg = _dryrun_cfg(
+            comm_dtype="int8" if name.endswith("int8") else "f32",
+            num_experts=2 * inner,
+        )
+        strategy = ExpertParallel(
+            create_mesh({"data": n_devices // inner, "expert": inner}, devices)
+        )
+    else:
+        cfg = _dryrun_cfg(comm_dtype="int8" if name.endswith("int8") else "f32")
+        cls = DataParallel if name.startswith("ddp") else FSDP
+        strategy = cls(create_mesh({"data": n_devices}, devices))
+
+    optimizer = make_optimizer(1e-3)
+    state = create_train_state(jax.random.PRNGKey(0), cfg, optimizer, strategy)
+    shapes = jax.eval_shape(lambda: state)
+    train_step, _, _ = make_step_fns(cfg, optimizer, strategy, shapes)
+
+    seq = 16 if 16 % n_devices == 0 else n_devices
+    divisor = strategy.batch_divisor
+    batch_n = -(-8 // divisor) * divisor
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(batch_n, seq)).astype(np.int32)
+    batch = {
+        "input_ids": ids,
+        "position_ids": np.ascontiguousarray(
+            np.broadcast_to(np.arange(seq, dtype=np.int32), ids.shape)
+        ),
+        "mask": np.zeros((batch_n, seq), dtype=bool),
+    }
+    targets = np.roll(ids, -1, axis=1).astype(np.int32)
+    struct = lambda x: jax.ShapeDtypeStruct(  # noqa: E731
+        np.asarray(x).shape, np.asarray(x).dtype
+    )
+    with capture_compiler_stderr() as cap:
+        compiled = train_step.lower(
+            shapes, jax.tree.map(struct, batch), struct(targets)
+        ).compile()
+    return {
+        "name": name,
+        "text": compiled.as_text(),
+        "stderr": cap["text"],
+        "plan": train_comm_plan(
+            strategy, cfg, param_shapes=shapes.params,
+            global_batch=batch_n, seq=seq, backend=jax.default_backend(),
+        ),
+        # train_step donates the whole state (make_step_fns
+        # donate_argnums=(0,)): every leaf must appear in the alias table
+        "expect_donated": len(jax.tree_util.tree_leaves(shapes)),
+        "comm_dtype": cfg.comm_dtype,
+    }
+
+
+def _decode_world(name: str, n_devices: int) -> dict:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from tpukit.analysis import decode_comm_plan
+    from tpukit.mesh import create_mesh
+    from tpukit.model import GPTConfig, init_params
+    from tpukit.model import gpt
+    from tpukit.obs.xla import capture_compiler_stderr
+    from tpukit.serve import paged as paged_lib
+    from tpukit.serve.decode import decode_step
+    from tpukit.shardings import TensorParallel
+
+    paged = name == "paged_decode"
+    cfg = GPTConfig(
+        dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=160,
+        max_position_embeddings=64, compute_dtype=jnp.float32,
+    )
+    mesh = create_mesh({"model": 4} if paged else {"data": 2, "model": 4})
+    slots, width, page, mp = 4, 24, 8, 3
+    strat = TensorParallel(mesh)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree.map(
+        jax.device_put, params, strat.state_sharding(jax.eval_shape(lambda: params))
+    )
+    sh = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    da = "data" if "data" in mesh.axis_names else None
+    if paged:
+        tree = paged_lib.init_paged_cache(
+            cfg, slots * mp + 1, page, mp, slots, "f32"
+        )
+        specs = {"k": P(None, None, "model", None, None),
+                 "v": P(None, None, "model", None, None),
+                 "ks": P(None, None, "model", None),
+                 "vs": P(None, None, "model", None), "bt": P()}
+        cache = {k: jax.device_put(np.asarray(v), sh(specs[k]))
+                 for k, v in tree.items()}
+        cache["bt"] = jax.device_put(
+            np.arange(1, slots * mp + 1, dtype=np.int32).reshape(slots, mp),
+            sh(P()),
+        )
+        width = mp * page
+    else:
+        cache = jax.tree.map(
+            lambda c: jax.device_put(c, sh(P(None, da, "model", None, None))),
+            gpt.init_kv_cache(cfg, slots, width),
+        )
+    buf = jax.device_put(np.zeros((slots, width), np.int32), sh(P(da, None)))
+    cursors = jax.device_put(np.full((slots,), 5, np.int32), sh(P(da)))
+    active = jax.device_put(np.ones((slots,), bool), sh(P(da)))
+    limits = jax.device_put(np.full((slots,), 12, np.int32), sh(P(da)))
+    keys = jax.device_put(np.zeros((slots, 2), np.uint32), sh(P(da, None)))
+    with capture_compiler_stderr() as cap:
+        compiled = decode_step.lower(
+            params, cfg, buf, cache, cursors, active, limits, keys,
+            1, 0.0, 0, mesh,
+        ).compile()
+    return {
+        "name": name,
+        "text": compiled.as_text(),
+        "stderr": cap["text"],
+        "plan": decode_comm_plan(cfg, mesh, slots, top_k=0, paged=paged),
+        # the serve jits deliberately do NOT donate (jaxlib deserialized-
+        # executable mis-alias, serve/decode.py) — nothing to expect
+        "expect_donated": None,
+        "comm_dtype": "f32",
+    }
+
+
+def build_world(name: str, n_devices: int) -> dict:
+    """Compile one audited world and return its lint context:
+    {name, text, stderr, plan, expect_donated, comm_dtype}."""
+    if name not in WORLDS:
+        raise SystemExit(f"unknown world {name!r} — known: {', '.join(WORLDS)}")
+    if name in ("tp_decode", "paged_decode"):
+        return _decode_world(name, n_devices)
+    return _train_world(name, n_devices)
+
+
+def lint_world(ctx: dict, waive: tuple[str, ...] = ()) -> list:
+    """Run the rule engine over one built world's context."""
+    import jax
+
+    from tpukit.analysis import lint_text
+
+    return lint_text(
+        ctx["text"],
+        plan=ctx["plan"],
+        compiler_stderr=ctx["stderr"],
+        backend=jax.default_backend(),
+        expect_donated=ctx["expect_donated"],
+        waive=waive,
+    )
+
+
+# -- fixtures ---------------------------------------------------------------
+
+def fixture_paths(directory: Path, name: str) -> tuple[Path, Path]:
+    return directory / f"{name}.hlo.txt.gz", directory / f"{name}.json"
+
+
+def sidecar_of(hlo_path: Path) -> Path:
+    """The JSON sidecar path next to a fixture's module text."""
+    name = hlo_path.name
+    for suffix in (".hlo.txt.gz", ".hlo.txt"):
+        if name.endswith(suffix):
+            return hlo_path.with_name(name[: -len(suffix)] + ".json")
+    return hlo_path.with_suffix(".json")
+
+
+def plan_from_meta(meta: dict):
+    """Rebuild the CommPlan a fixture sidecar recorded at capture time
+    (the one spelling tests/test_analysis.py uses too)."""
+    from tpukit.analysis import CommPlan
+
+    p = meta.get("plan")
+    if p is None:
+        return None
+    return CommPlan(
+        label=meta.get("world", "fixture"), ops=p["ops"], wire=p["wire"],
+        exhaustive=p["exhaustive"], comm_dtype=meta.get("comm_dtype", "f32"),
+    )
+
+
+def read_fixture(path: Path) -> str:
+    """Module text of a fixture (gz or plain)."""
+    if str(path).endswith(".gz"):
+        return gzip.decompress(path.read_bytes()).decode("utf-8")
+    return path.read_text()
+
+
+def save_fixture(directory: Path, ctx: dict) -> None:
+    import jax
+
+    from tpukit.analysis import count_involuntary_remat, parse_hlo
+    from tpukit.analysis.hlo_ir import collective_summary
+
+    directory.mkdir(parents=True, exist_ok=True)
+    hlo_path, meta_path = fixture_paths(directory, ctx["name"])
+    hlo_path.write_bytes(
+        gzip.compress(ctx["text"].encode("utf-8"), compresslevel=9)
+    )
+    module = parse_hlo(ctx["text"])
+    plan = ctx["plan"]
+    meta = {
+        "world": ctx["name"],
+        "comm_dtype": ctx["comm_dtype"],
+        # the capture backend decides wire-upcast severity (XLA:CPU's
+        # bf16->f32 normalization warns instead of erroring) — without it
+        # a saved bf16-wire dump would flip from clean to violation
+        "backend": jax.default_backend(),
+        "expect_donated": ctx["expect_donated"],
+        "collectives": collective_summary(module),
+        "plan": None if plan is None else {
+            "ops": plan.ops, "wire": plan.wire, "exhaustive": plan.exhaustive,
+        },
+        "remat_warnings": count_involuntary_remat(ctx["stderr"]),
+        "jax_version": jax.__version__,
+        "regenerate": (
+            f"python tools/hlolint.py --world 8 --save-hlo "
+            f"tests/fixtures/hlo --worlds {ctx['name']}"
+        ),
+    }
+    meta_path.write_text(json.dumps(meta, indent=1, sort_keys=True) + "\n")
+
+
+# -- CLI --------------------------------------------------------------------
+
+def _emit(findings, common: dict, out, human: bool) -> None:
+    for f in findings:
+        rec = f.to_record(**common)
+        out.write(json.dumps(rec) + "\n")
+    if human:
+        for f in findings:
+            print(f"  [{f.severity:<5}] {f.rule}: {f.message}",
+                  file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument("--world", type=int, default=0, metavar="N",
+                    help="compile + lint the audited worlds on N virtual devices")
+    ap.add_argument("--worlds", default=",".join(WORLDS),
+                    help=f"comma list to restrict --world (default: all of "
+                         f"{', '.join(WORLDS)})")
+    ap.add_argument("--hlo", nargs="*", default=[],
+                    help="saved HLO text file(s) (.gz ok) to lint rules-only")
+    ap.add_argument("--stderr", default=None,
+                    help="captured compiler stderr for --hlo (remat rule)")
+    ap.add_argument("--expect-donated", type=int, default=None,
+                    help="donated-leaf count for --hlo (donation rule)")
+    ap.add_argument("--backend", default=None,
+                    help="capture backend for --hlo (wire-upcast severity; "
+                         "a fixture sidecar records it)")
+    ap.add_argument("--waive", default="",
+                    help="comma list of rules to skip (prints what it waived)")
+    ap.add_argument("--out", default=None,
+                    help="write findings JSONL here instead of stdout")
+    ap.add_argument("--save-hlo", default=None, metavar="DIR",
+                    help="with --world: write golden fixtures (gz + sidecar)")
+    args = ap.parse_args(argv)
+
+    if not args.world and not args.hlo:
+        ap.error("nothing to lint: pass --world N and/or --hlo FILE")
+
+    waive = tuple(w for w in args.waive.split(",") if w)
+    if waive:
+        print(f"hlolint: waiving rule(s): {', '.join(waive)}", file=sys.stderr)
+
+    out = open(args.out, "w") if args.out else sys.stdout
+    human = out is not sys.stdout
+    errors = 0
+    try:
+        for path in args.hlo:
+            p = Path(path)
+            text = read_fixture(p)
+            stderr_text = Path(args.stderr).read_text() if args.stderr else ""
+            from tpukit.analysis import lint_text, summarize
+
+            # a fixture's JSON sidecar restores the capture-time plan,
+            # donation expectation and backend, so linting the saved text
+            # runs the SAME audit the live world did; explicit flags win
+            plan, donated, backend = None, args.expect_donated, args.backend
+            side = sidecar_of(p)
+            if side.exists():
+                meta = json.loads(side.read_text())
+                plan = plan_from_meta(meta)
+                if donated is None:
+                    donated = meta.get("expect_donated")
+                if backend is None:
+                    backend = meta.get("backend")
+            findings = lint_text(
+                text, plan=plan, compiler_stderr=stderr_text,
+                backend=backend, expect_donated=donated, waive=waive,
+            )
+            s = summarize(findings)
+            print(f"hlolint {p.name}: "
+                  f"{'clean' if s['clean'] else s['violations']}"
+                  f" ({s['errors']} errors, {s['warnings']} warnings)"
+                  + (" [sidecar plan]" if plan is not None else ""),
+                  file=sys.stderr)
+            _emit(findings, {"source": str(p)}, out, human)
+            errors += s["errors"]
+
+        if args.world:
+            _ensure_env(args.world)
+            names = tuple(w for w in args.worlds.split(",") if w)
+            save_dir = Path(args.save_hlo) if args.save_hlo else None
+            if save_dir is not None and args.worlds == ",".join(WORLDS):
+                # fixture capture defaults to the golden subset (ep_int8
+                # re-compiles the most expensive world for no fixture
+                # value); an explicit --worlds list always wins
+                names = FIXTURE_WORLDS
+            from tpukit.analysis import summarize
+
+            for name in names:
+                ctx = build_world(name, args.world)
+                findings = lint_world(ctx, waive=waive)
+                s = summarize(findings)
+                plan = ctx["plan"]
+                planned = (
+                    " planned:" + ",".join(
+                        f"{op}x{rec['count']}@{rec['bytes']}B"
+                        for op, rec in sorted(plan.ops.items())
+                    ) if plan is not None and plan.ops else ""
+                )
+                print(f"hlolint world {name}: "
+                      f"{'clean' if s['clean'] else s['violations']}"
+                      f" ({s['errors']} errors, {s['warnings']} warnings)"
+                      + planned,
+                      file=sys.stderr)
+                _emit(findings, {"world": name}, out, human)
+                errors += s["errors"]
+                if save_dir is not None:
+                    save_fixture(save_dir, ctx)
+    finally:
+        if out is not sys.stdout:
+            out.close()
+
+    if errors:
+        print(f"hlolint: {errors} violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -237,6 +237,39 @@ def summarize(records: list[dict]) -> str:
                   f"{human_bytes(meas['bytes'])} vs expected x{rec['count']} "
                   f"{human_bytes(rec['bytes'])}"
                   + ("  OK" if ok else "  <- MISMATCH"))
+        # round-16 hlolint verdicts (tpukit/analysis): the rule-engine
+        # summary fit() stamped on the record — CommPlan diff + the named
+        # anti-pattern rules, one line unless something fired.
+        hl = r.get("hlolint")
+        if hl is not None:
+            if hl.get("clean"):
+                line = "  hlolint: clean"
+            else:
+                line = (f"  hlolint: {hl.get('errors', '?')} violation(s) "
+                        f"<- {', '.join(hl.get('violations') or [])}")
+            if hl.get("warnings"):
+                line += (f"   ({hl['warnings']} warning(s): "
+                         f"{', '.join(hl.get('warned') or [])})")
+            ov = hl.get("overlap")
+            if ov:
+                line += (f"   overlap: {ov.get('overlapped', 0)}/"
+                         f"{ov.get('pairs', 0)} async pairs hide compute")
+            w(line)
+
+    # standalone hlolint findings (tools/hlolint.py --out, or its JSONL
+    # appended to a run log): grouped by world/source, errors first
+    hlolint_rows = _rows(records, "hlolint")
+    if hlolint_rows:
+        w("== xla static analysis: hlolint findings ==")
+        by_src: dict[str, list] = {}
+        for r in hlolint_rows:
+            by_src.setdefault(r.get("world") or r.get("source") or "?", []).append(r)
+        for src, rows in sorted(by_src.items()):
+            errs = sum(1 for r in rows if r.get("severity") == "error")
+            w(f"  {src}: {len(rows)} finding(s), {errs} error(s)")
+            for r in rows:
+                w(f"    [{r.get('severity', '?'):<5}] {r.get('rule', '?')}: "
+                  f"{r.get('message', '')}")
 
     val = _rows(records, "validation")
     epochs = _rows(records, "epoch")

@@ -1,0 +1,42 @@
+"""tpukit.analysis — structured static analysis of compiled programs.
+
+Three layers (docs/DESIGN.md §15):
+
+  - `hlo_ir`: parse optimized HLO text into computations → instructions
+    with shapes/dtypes, while-body membership, async start/done pairing
+    and the executable's input–output alias table. jax-free.
+  - `plan`: CommPlan — the declared collective schedule (grad_comm /
+    dispatch_comm / decode_step_comm unified behind one interface).
+  - `rules`: the named anti-pattern rules + lint driver; every rule is a
+    regression this repo hit, with the incident cited in its docstring.
+
+`tools/hlolint.py` is the CLI; `__graft_entry__.dryrun_multichip` and
+fit()'s kind="xla" record invoke the same engine.
+"""
+
+from tpukit.analysis.hlo_ir import (  # noqa: F401
+    COLLECTIVE_OPS,
+    Alias,
+    AsyncPair,
+    Computation,
+    HloModule,
+    Instruction,
+    collective_summary,
+    parse_hlo,
+)
+from tpukit.analysis.plan import (  # noqa: F401
+    CommPlan,
+    decode_comm_plan,
+    ring_wire_bytes,
+    train_comm_plan,
+)
+from tpukit.analysis.rules import (  # noqa: F401
+    INVOLUNTARY_REMAT,
+    RULES,
+    Finding,
+    assert_clean,
+    count_involuntary_remat,
+    lint_module,
+    lint_text,
+    summarize,
+)

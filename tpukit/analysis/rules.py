@@ -1,0 +1,377 @@
+"""hlolint rule engine — named anti-pattern rules over the HLO IR.
+
+Every rule here is a regression this repo actually hit, promoted from a
+bespoke assertion scattered across the tree into one named, reusable
+check (the catalog below cites the original incident). `lint_module`
+runs them all over a parsed module (+ the compile's captured stderr and
+the caller's declared CommPlan) and returns findings; `assert_clean`
+turns error findings into the RAISE discipline the dryrun and CI lanes
+enforce.
+
+Rule catalog (and where each one came from):
+
+  comm-plan          The declared CommPlan (grad_comm / dispatch_comm /
+                     decode_step_comm unified, analysis/plan.py) diffed
+                     against the module's collectives — the round-10
+                     "hand-scheduling means predicting" discipline, one
+                     spelling instead of four comparison loops.
+  involuntary-remat  `[SPMD] Involuntary full rematerialization` in the
+                     captured compiler stderr: GSPMD replicated a tensor
+                     it could not reshard (the round-5 EP einsum dispatch,
+                     MULTICHIP_r05). Zero is the bar for any hand-placed
+                     schedule.
+  s32-index-plumbing Integer-dtype collectives serving scatter/gather
+                     index exchange — GSPMD partitioning a batched
+                     scatter emits s32 collective-permute/all-gather
+                     plumbing (the round-14 decode buf scatter, rewritten
+                     as a one-hot select). s8/u8 payloads are quantized
+                     data, never indices, and small integer psums
+                     (token counts) sit under the byte threshold. Error
+                     on hand-scheduled programs (a CommPlan is declared),
+                     warn on GSPMD-placed worlds, where small id gathers
+                     for row-sharded tables are the partitioner's
+                     legitimate cost (the f32 FSDP embedding `_take`).
+  wire-upcast        A collective element dtype wider than the declared
+                     wire dtype (--comm_dtype / the plan's per-op wire
+                     entry) — the round-12 finding that XLA:CPU's float
+                     normalization moves bf16 payloads at f32, now a
+                     named rule instead of a renderer soft-excuse. int8
+                     payloads are upcast-immune: any widening there is a
+                     hard error on every backend.
+  donation-dropped   Donated arguments missing from the executable's
+                     input_output_alias table — silent 2x HBM, and the
+                     round-14 jaxlib class where executables DESERIALIZED
+                     from the persistent compile cache mis-alias donated
+                     buffers (serve/decode.py strips donation for exactly
+                     that reason).
+  overlap            For each async `-start`/`-done` pair, does compute
+                     actually sit between them? Reporting-only today
+                     (severity "info"); becomes the ROADMAP #5 gate when
+                     the bucketed grad exchange lands.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpukit.analysis import hlo_ir
+from tpukit.analysis.hlo_ir import HloModule, collective_summary
+from tpukit.analysis.plan import CommPlan
+
+# The GSPMD partitioner's last-resort warning (spmd_partitioner.cc): it
+# could not move a tensor between two shardings efficiently, so it
+# REPLICATES the full tensor and re-partitions — for MoE dispatch that is
+# exactly the all-device traffic expert parallelism exists to avoid.
+INVOLUNTARY_REMAT = "Involuntary full rematerialization"
+
+# Integer collective payloads smaller than this are scalar bookkeeping
+# (token counts, loop carries), not index plumbing.
+S32_PLUMBING_MIN_BYTES = 256
+
+SEVERITIES = ("error", "warn", "info")
+
+
+@dataclass
+class Finding:
+    """One rule verdict. `severity` "error" findings fail `assert_clean`
+    (the dryrun/CI RAISE discipline); "warn" renders loudly but passes;
+    "info" is reporting (the overlap audit today)."""
+
+    rule: str
+    severity: str
+    message: str
+    computation: str | None = None
+    instruction: str | None = None
+    data: dict = field(default_factory=dict)
+
+    def to_record(self, **common) -> dict:
+        """JSONL row (kind="hlolint", DESIGN.md §6)."""
+        rec = {"kind": "hlolint", "rule": self.rule,
+               "severity": self.severity, "message": self.message}
+        if self.computation:
+            rec["computation"] = self.computation
+        if self.instruction:
+            rec["instruction"] = self.instruction
+        if self.data:
+            rec["data"] = self.data
+        rec.update(common)
+        return rec
+
+
+def count_involuntary_remat(text: str) -> int:
+    """Number of `[SPMD] Involuntary full rematerialization` warnings in a
+    compiler log / captured stderr — each one is a tensor GSPMD gave up on
+    and resolved by replicate-then-repartition. Zero is the bar for any
+    step whose collectives are hand-placed."""
+    return text.count(INVOLUNTARY_REMAT)
+
+
+# -- individual rules -------------------------------------------------------
+
+
+def _rule_comm_plan(module: HloModule, ctx: dict) -> list[Finding]:
+    plan: CommPlan | None = ctx.get("plan")
+    if plan is None:
+        return []
+    measured = collective_summary(module)
+    out = []
+    for op, exp in sorted(plan.ops.items()):
+        got = measured.get(op, {"count": 0, "bytes": 0})
+        if got["count"] != exp["count"] or got["bytes"] != exp["bytes"]:
+            out.append(Finding(
+                rule="comm-plan", severity="error",
+                message=(
+                    f"{plan.label}: {op} measured x{got['count']} "
+                    f"{got['bytes']}B vs declared x{exp['count']} "
+                    f"{exp['bytes']}B"
+                ),
+                data={"op": op, "measured": got, "expected": dict(exp)},
+            ))
+    if plan.exhaustive:
+        for op, got in sorted(measured.items()):
+            if op not in plan.ops:
+                out.append(Finding(
+                    rule="comm-plan", severity="error",
+                    message=(
+                        f"{plan.label}: unplanned {op} x{got['count']} "
+                        f"{got['bytes']}B (plan is exhaustive — every "
+                        f"collective must be declared)"
+                    ),
+                    data={"op": op, "measured": got},
+                ))
+    return out
+
+
+def _rule_involuntary_remat(module: HloModule, ctx: dict) -> list[Finding]:
+    n = count_involuntary_remat(ctx.get("compiler_stderr") or "")
+    if not n:
+        return []
+    return [Finding(
+        rule="involuntary-remat", severity="error",
+        message=(
+            f"compile emitted {n} '[SPMD] {INVOLUNTARY_REMAT}' warning(s) "
+            f"— GSPMD fell back to replicate-then-repartition (the round-5 "
+            f"EP dispatch regression); hand-placed collectives must make "
+            f"this zero"
+        ),
+        data={"count": n},
+    )]
+
+
+def _rule_s32_index_plumbing(module: HloModule, ctx: dict) -> list[Finding]:
+    # The zero bar applies to HAND-SCHEDULED programs (a CommPlan was
+    # declared): there, integer collectives mean GSPMD partitioned a
+    # scatter/gather through index exchange behind the schedule's back.
+    # GSPMD-placed worlds (no plan) legitimately carry small id gathers —
+    # e.g. the f32 FSDP world all-gathers the batch-sharded token ids so
+    # every shard of the row-sharded embedding table can run its local
+    # `_take` gather and scatter-add — so those report as "warn": visible
+    # in the renderer, not a CI failure.
+    severity = "error" if ctx.get("plan") is not None else "warn"
+    out = []
+    for instr in module.collectives():
+        int_bytes = sum(
+            b for dt, b in _payload_dtypes(instr)
+            if dt in hlo_ir.INDEX_DTYPES
+        )
+        if int_bytes <= S32_PLUMBING_MIN_BYTES:
+            continue
+        out.append(Finding(
+            rule="s32-index-plumbing", severity=severity,
+            message=(
+                f"{instr.opcode} %{instr.name} moves {int_bytes}B of "
+                f"integer payload — GSPMD index plumbing for a partitioned "
+                f"scatter/gather (the round-14 decode buf scatter class; "
+                f"rewrite the scatter as a one-hot select or reshard the "
+                f"indices)"
+            ),
+            computation=instr.computation, instruction=instr.name,
+            data={"op": instr.base_op, "int_bytes": int_bytes,
+                  "dtypes": sorted(instr.result_dtypes())},
+        ))
+    return out
+
+
+def _payload_dtypes(instr) -> list[tuple[str, int]]:
+    """(dtype, bytes) of the real payload arrays — async ctx scalars
+    excluded AND the operand-alias half of async `-start` tuples dropped
+    (hlo_ir.payload_shapes), so wire-upcast and s32-plumbing never price
+    an aliased operand as payload."""
+    return hlo_ir.payload_shapes(
+        instr.raw_shape, instr.base_op, instr.is_start
+    )
+
+
+def _rule_wire_upcast(module: HloModule, ctx: dict) -> list[Finding]:
+    plan: CommPlan | None = ctx.get("plan")
+    if plan is None or not plan.wire:
+        return []
+    backend = ctx.get("backend")
+    out = []
+    for instr in module.collectives():
+        expected = plan.wire.get(instr.base_op)
+        if expected is None:
+            continue
+        exp_size = hlo_ir.itemsize(expected) or 4
+        for dt, b in _payload_dtypes(instr):
+            size = hlo_ir.itemsize(dt)
+            if size is None or size <= exp_size:
+                continue
+            cpu_bf16 = (expected == "bf16" and dt == "f32"
+                        and backend == "cpu")
+            out.append(Finding(
+                rule="wire-upcast",
+                # the known XLA:CPU float normalization is named, not
+                # silent — but it is the backend's doing, not a schedule
+                # regression, so it warns instead of failing CI
+                severity="warn" if cpu_bf16 else "error",
+                message=(
+                    f"{instr.opcode} %{instr.name} moves {dt} payload, "
+                    f"declared wire dtype is {expected}"
+                    + (" (XLA:CPU float normalization upcasts bf16 "
+                       "payloads to f32 on the wire — the round-12 "
+                       "finding)" if cpu_bf16 else
+                       " — the payload travels wider than the config "
+                       "promised")
+                ),
+                computation=instr.computation, instruction=instr.name,
+                data={"op": instr.base_op, "declared": expected,
+                      "actual": dt, "bytes": b},
+            ))
+            break  # one finding per instruction
+    return out
+
+
+def _rule_donation_dropped(module: HloModule, ctx: dict) -> list[Finding]:
+    expect = ctx.get("expect_donated")
+    if not expect:
+        return []
+    aliased = module.aliased_params()
+    missing = sorted(set(range(int(expect))) - aliased)
+    if not missing:
+        return []
+    shown = ", ".join(str(p) for p in missing[:8])
+    more = f" (+{len(missing) - 8} more)" if len(missing) > 8 else ""
+    return [Finding(
+        rule="donation-dropped", severity="error",
+        message=(
+            f"{len(missing)} of {expect} donated parameters missing from "
+            f"the input_output_alias table (params {shown}{more}) — "
+            f"donated state that does not alias is a silent 2x HBM "
+            f"footprint"
+            + ("; an EMPTY table on a donated jit is also the round-14 "
+               "deserialized-executable mis-alias class"
+               if not aliased else "")
+        ),
+        data={"expected": int(expect), "aliased": len(aliased),
+              "missing": missing[:32]},
+    )]
+
+
+def _rule_overlap(module: HloModule, ctx: dict) -> list[Finding]:
+    out = []
+    for pair in module.async_pairs():
+        out.append(Finding(
+            rule="overlap", severity="info",
+            message=(
+                f"{pair.start.opcode} %{pair.start.name}: "
+                f"{pair.compute_between} compute op(s) between start and "
+                f"done — "
+                + ("overlapped" if pair.overlapped
+                   else "NO overlap (the pair completes back-to-back; "
+                        "the async form bought nothing)")
+            ),
+            computation=pair.start.computation,
+            instruction=pair.start.name,
+            data={"op": pair.start.base_op,
+                  "compute_between": pair.compute_between,
+                  "between": len(pair.between),
+                  "overlapped": pair.overlapped},
+        ))
+    return out
+
+
+RULES = {
+    "comm-plan": _rule_comm_plan,
+    "involuntary-remat": _rule_involuntary_remat,
+    "s32-index-plumbing": _rule_s32_index_plumbing,
+    "wire-upcast": _rule_wire_upcast,
+    "donation-dropped": _rule_donation_dropped,
+    "overlap": _rule_overlap,
+}
+
+
+def lint_module(
+    module: HloModule,
+    *,
+    plan: CommPlan | None = None,
+    compiler_stderr: str = "",
+    backend: str | None = None,
+    expect_donated: int | None = None,
+    waive: tuple[str, ...] = (),
+) -> list[Finding]:
+    """Run every rule over a parsed module. `waive` names rules to skip
+    (a lint must be silenceable per call site, loudly — the dryrun prints
+    what it waived). Findings come back error-first."""
+    unknown = set(waive) - set(RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown hlolint rule(s) in waiver: {sorted(unknown)} — "
+            f"known: {sorted(RULES)}"
+        )
+    ctx = {
+        "plan": plan,
+        "compiler_stderr": compiler_stderr,
+        "backend": backend,
+        "expect_donated": expect_donated,
+    }
+    findings: list[Finding] = []
+    for name, rule in RULES.items():
+        if name in waive:
+            continue
+        findings.extend(rule(module, ctx))
+    order = {s: i for i, s in enumerate(SEVERITIES)}
+    findings.sort(key=lambda f: (order.get(f.severity, 99), f.rule))
+    return findings
+
+
+def lint_text(text: str, **kwargs) -> list[Finding]:
+    """Parse + lint in one call (the CLI / fixture path)."""
+    return lint_module(hlo_ir.parse_hlo(text), **kwargs)
+
+
+def summarize(findings: list[Finding]) -> dict:
+    """Compact verdict for a JSONL record (fit()'s kind="xla" row):
+    error/warn counts, the violated rule names, and the overlap tally."""
+    errors = [f for f in findings if f.severity == "error"]
+    warns = [f for f in findings if f.severity == "warn"]
+    pairs = [f for f in findings if f.rule == "overlap"]
+    out = {
+        "clean": not errors,
+        "errors": len(errors),
+        "warnings": len(warns),
+        "violations": sorted({f.rule for f in errors}),
+    }
+    if warns:
+        out["warned"] = sorted({f.rule for f in warns})
+    if pairs:
+        out["overlap"] = {
+            "pairs": len(pairs),
+            "overlapped": sum(
+                1 for f in pairs if f.data.get("overlapped")
+            ),
+        }
+    return out
+
+
+def assert_clean(findings: list[Finding], label: str = "") -> None:
+    """RAISE on any error finding — the dryrun/CI discipline. The message
+    carries every error so a red MULTICHIP record names the regression."""
+    errors = [f for f in findings if f.severity == "error"]
+    if not errors:
+        return
+    lines = "\n".join(f"  [{f.rule}] {f.message}" for f in errors)
+    raise AssertionError(
+        f"hlolint: {len(errors)} violation(s)"
+        + (f" in {label}" if label else "") + f":\n{lines}"
+    )

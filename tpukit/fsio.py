@@ -1,0 +1,41 @@
+"""Dependency-light filesystem primitives shared across the host side.
+
+`atomic_write_text` is THE one tmp+rename publish spelling
+(tools/lint_invariants.py enforces it): write to `<path><suffix>.tmp`,
+then `os.replace` into place, so a reader never sees a torn file and
+concurrent writers of the same path converge on last-writer-wins instead
+of interleaving. It lives here — stdlib only, no jax/flax — because its
+callers span the weight classes: checkpoint sidecars and manifests
+(tpukit/checkpoint.py, which delegates), heartbeat liveness files
+(obs/heartbeat.py, written every window), and the hang watchdog's
+diagnostics bundles (obs/watchdog.py, written from the monitor thread at
+the worst possible moment — importing a jax-heavy module there would
+block the dump behind the import machinery the stuck main thread may
+hold).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def atomic_write_text(path: Path, text: str) -> None:
+    """Publish `text` at `path` atomically (tmp sibling + rename).
+
+    The tmp name appends `.tmp` to the FULL suffix (`beat.json` →
+    `beat.json.tmp`), so `*.json` globs over a shared directory never
+    match an in-flight write."""
+    path = Path(path)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)
+
+
+def atomic_write_bytes(path: Path, blob: bytes) -> None:
+    """Binary twin of `atomic_write_text` — same tmp naming, same rename
+    rule (checkpoint blobs, anything a text write would mangle)."""
+    path = Path(path)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_bytes(blob)
+    os.replace(tmp, path)

@@ -30,6 +30,8 @@ import os
 import time
 from pathlib import Path
 
+from tpukit.fsio import atomic_write_text
+
 
 def _beat_path(directory: Path, process_index: int) -> Path:
     return directory / f"heartbeat-p{process_index:05d}.json"
@@ -101,9 +103,9 @@ class Heartbeat:
             rec["checksum_step"] = int(
                 checksum_step if checksum_step is not None else step
             )
-        tmp = self.path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(rec))
-        os.replace(tmp, self.path)
+        # one atomic-publish spelling repo-wide (tools/lint_invariants.py);
+        # fsio is stdlib-only, so this stays importable without jax
+        atomic_write_text(self.path, json.dumps(rec))
 
     def read_all(self) -> dict[int, dict]:
         """All readable beat records in the directory, keyed by process."""

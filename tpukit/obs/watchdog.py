@@ -44,6 +44,8 @@ import traceback
 from pathlib import Path
 from typing import Any, Callable
 
+from tpukit.fsio import atomic_write_text
+
 
 def all_thread_stacks() -> dict[str, list[str]]:
     """Formatted stack of every live Python thread, keyed by
@@ -146,9 +148,10 @@ def write_bundle(
         f"-p{proc:05d}-{stamp}.json"
     )
     path = directory / name
-    tmp = path.with_suffix(".tmp")
-    tmp.write_text(json.dumps(bundle, indent=1, default=repr))
-    os.replace(tmp, path)
+    # one atomic-publish spelling repo-wide (tools/lint_invariants.py);
+    # fsio is stdlib-only, so the monitor thread's dump never waits on a
+    # heavyweight import while the main thread is wedged
+    atomic_write_text(path, json.dumps(bundle, indent=1, default=repr))
     return path
 
 

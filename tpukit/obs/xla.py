@@ -28,6 +28,13 @@ replicate-then-repartition last resort — the round-5 EP dispatch
 regression MULTICHIP_r05.json caught; zero is the bar for any step whose
 collectives are placed by hand).
 
+Round 16: the flat-regex HLO parse moved into `tpukit/analysis/hlo_ir.py`
+as a structured IR (computations → instructions, while-body membership,
+async pairing, the alias table). `collective_bytes`/`wire_bytes` here are
+thin wrappers over it — same contract, same numbers (the golden-fixture
+tests prove byte-for-byte equality against the original regex, kept below
+as `_collective_bytes_regex` for exactly that proof).
+
 Everything here is best-effort: any backend that lacks an analysis returns
 None for that field rather than raising — telemetry must never take down a
 training run.
@@ -43,27 +50,20 @@ import tempfile
 
 import jax
 
-# HLO collective ops worth metering, normalized (async "-start" variants
-# fold into the base name; "-done" carries no payload and is skipped).
-COLLECTIVE_OPS = (
-    "all-reduce",
-    "all-gather",
-    "reduce-scatter",
-    "collective-permute",
-    "all-to-all",
+from tpukit.analysis import hlo_ir as _ir
+from tpukit.analysis import plan as _plan
+from tpukit.analysis.rules import (  # noqa: F401  (re-exported API)
+    INVOLUNTARY_REMAT,
+    count_involuntary_remat,
 )
 
-_ITEMSIZE = {
-    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
-    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4,
-    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
-    "c128": 16,
-}
+# Re-exported: the one spelling lives in analysis/hlo_ir.py.
+COLLECTIVE_OPS = _ir.COLLECTIVE_OPS
 
-# `f32[8,256]{1,0}` or scalar `f32[]` — group 1 dtype, group 2 dims.
-_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
-# `%x = SHAPES op-name(` where SHAPES is a single shape or a (tuple).
+# The pre-round-16 flat parse: `%x = SHAPES op-name(` where SHAPES is a
+# single shape or a (tuple). Kept ONLY as the equivalence oracle for the
+# golden-fixture tests (tests/test_analysis.py) — production callers go
+# through the IR.
 _OP_RE = re.compile(
     r"=\s+((?:\([^)]*\))|(?:\S+))\s+("
     + "|".join(COLLECTIVE_OPS)
@@ -71,45 +71,17 @@ _OP_RE = re.compile(
 )
 
 
-def _shape_list(shape_str: str) -> list[tuple[str, int]]:
-    """[(dtype, bytes)] for every array shape in a shape/tuple string."""
-    out = []
-    for dtype, dims in _SHAPE_RE.findall(shape_str):
-        size = _ITEMSIZE.get(dtype)
-        if size is None:
-            continue  # token/opaque types carry no payload
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        out.append((dtype, n * size))
+def _collective_bytes_regex(hlo_text: str) -> dict[str, dict[str, int]]:
+    """The original flat-regex parse, verbatim semantics. Test oracle."""
+    out: dict[str, dict[str, int]] = {}
+    for m in _OP_RE.finditer(hlo_text):
+        shape_str, op, start = m.group(1), m.group(2), m.group(3)
+        rec = out.setdefault(op, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += _ir.result_payload_bytes(
+            shape_str, op, is_start=start is not None
+        )
     return out
-
-
-# Async `-start` ops whose result tuple ALIASES the operands alongside the
-# results: `(operands..., results..., ctx scalars...)`. all-reduce-start's
-# tuple (when present) holds only the reduced results — XLA's combiner
-# fuses grad buffers into one variadic all-reduce — so halving it would
-# drop real payload.
-_START_WITH_OPERAND_ALIASES = ("all-gather", "collective-permute")
-
-
-def _result_bytes(shape_str: str, op: str, is_start: bool) -> int:
-    """Result payload of one collective instance. Sync ops: the full result
-    shape (a tuple IS the result for multi-operand all-reduce). For async
-    `-start` forms of the operand-aliasing ops above, count only the
-    results half, else the aliases double the reported volume on exactly
-    the backends (TPU) that emit async pairs."""
-    shapes = _shape_list(shape_str)
-    if is_start and op in _START_WITH_OPERAND_ALIASES:
-        # drop the u32/s32 context scalars these async ops append
-        shapes = [
-            (dt, b) for dt, b in shapes
-            if not (b <= 8 and dt in ("u32", "s32", "u64", "s64"))
-        ]
-        if len(shapes) >= 2 and len(shapes) % 2 == 0:
-            shapes = shapes[len(shapes) // 2:]
-    return sum(b for _, b in shapes)
 
 
 def collective_bytes(hlo_text: str) -> dict[str, dict[str, int]]:
@@ -117,74 +89,35 @@ def collective_bytes(hlo_text: str) -> dict[str, dict[str, int]]:
     collective kinds above. `bytes` is the summed RESULT payload of each op
     instance — the volume moved per executed step (an all-reduce's result
     equals its input size; an all-gather's result is the post-gather
-    size). Async `-start`/`-done` pairs count once, by their result."""
-    out: dict[str, dict[str, int]] = {}
-    for m in _OP_RE.finditer(hlo_text):
-        shape_str, op, start = m.group(1), m.group(2), m.group(3)
-        rec = out.setdefault(op, {"count": 0, "bytes": 0})
-        rec["count"] += 1
-        rec["bytes"] += _result_bytes(shape_str, op, is_start=start is not None)
-    return out
+    size). Async `-start`/`-done` pairs count once, by their result.
+
+    Thin wrapper over the structured IR (analysis/hlo_ir.py): each op is
+    attributed to its computation once — a collective inside a while body
+    is the body's, not a text offset — so rule-engine callers and this
+    summary read the same parse."""
+    return _ir.collective_summary(_ir.parse_hlo(hlo_text))
 
 
 def wire_bytes(collectives: dict[str, dict[str, int]], world: int) -> int:
-    """Estimated bytes each device actually moves over the interconnect
-    for the parsed collectives, from their RESULT payloads (what
-    `collective_bytes` reports) via the standard ring-algorithm cost
-    model. Needed because result bytes are not comparable ACROSS op kinds:
-    a reduce-scatter's result is 1/world of the data it moved, an
-    all-reduce moves ~2x its result (reduce-scatter + all-gather phases).
-    Per-device wire cost for result payload R on a `world`-way ring:
-
-      all-reduce         2 * R * (world-1)/world   (RS + AG phases)
-      all-gather             R * (world-1)/world
-      all-to-all             R * (world-1)/world
-      reduce-scatter         R * (world-1)          (result is 1/world)
-      collective-permute     R                      (one hop)
-
-    This is the denominator-normalizer for the quantized-collective
-    headline (bench.py's quant_comm record, tests): "int8 moves <= 30% of
-    the f32 wire bytes" compares ring-model wire, not raw result sizes."""
-    if world <= 1:
-        return 0
-    frac = (world - 1) / world
-    mult = {
-        "all-reduce": 2.0 * frac,
-        "all-gather": frac,
-        "all-to-all": frac,
-        "reduce-scatter": float(world - 1),
-        "collective-permute": 1.0,
-    }
-    total = 0.0
-    for op, rec in collectives.items():
-        total += rec.get("bytes", 0) * mult.get(op, 1.0)
-    return int(total)
-
-
-# The GSPMD partitioner's last-resort warning (spmd_partitioner.cc): it
-# could not move a tensor between two shardings efficiently, so it
-# REPLICATES the full tensor and re-partitions — for MoE dispatch that is
-# exactly the all-device traffic expert parallelism exists to avoid. The
-# round-5 EP dryrun hit this on the backward of the dispatch einsum
-# (MULTICHIP_r05.json); the a2a dispatch path must never trigger it.
-INVOLUNTARY_REMAT = "Involuntary full rematerialization"
-
-
-def count_involuntary_remat(text: str) -> int:
-    """Number of `[SPMD] Involuntary full rematerialization` warnings in a
-    compiler log / captured stderr — each one is a tensor GSPMD gave up on
-    and resolved by replicate-then-repartition. Zero is the bar for any
-    step whose collectives are hand-placed."""
-    return text.count(INVOLUNTARY_REMAT)
+    """Ring-model per-device interconnect bytes for a parsed collective
+    summary — see `analysis.plan.ring_wire_bytes` (the one spelling; this
+    wrapper keeps the historical obs import path)."""
+    return _plan.ring_wire_bytes(collectives, world)
 
 
 @contextlib.contextmanager
-def capture_compiler_stderr():
+def capture_compiler_stderr(check: bool = False):
     """Capture OS-level stderr (fd 2) for the duration of the block — the
     channel XLA's C++ partitioner warnings arrive on, which Python-level
     sys.stderr redirection cannot see. Yields a dict whose "text" key holds
     the captured output after the block exits; whatever was captured is
     re-emitted to the real stderr so no diagnostics are swallowed.
+
+    The involuntary-remat count is tallied at exit into the holder's
+    "involuntary_remat" key — callers that used to re-spell
+    `count_involuntary_remat(cap["text"])` read the count instead.
+    `check=True` additionally RAISES on a nonzero count (the dryrun/test
+    discipline: hand-placed collectives must compile warning-free).
 
     Used to audit a compile for involuntary-remat warnings (the dryrun's
     EP world, bench.py's moe_ep_comm probe, tests). Note: a compile served
@@ -192,7 +125,7 @@ def capture_compiler_stderr():
     the audit is meaningful on cold compiles.
     """
     sys.stderr.flush()
-    holder = {"text": ""}
+    holder = {"text": "", "involuntary_remat": 0}
     saved = os.dup(2)
     tmp = tempfile.TemporaryFile(mode="w+b")
     try:
@@ -208,6 +141,13 @@ def capture_compiler_stderr():
         if holder["text"]:
             sys.stderr.write(holder["text"])
             sys.stderr.flush()
+        holder["involuntary_remat"] = count_involuntary_remat(holder["text"])
+    if check and holder["involuntary_remat"]:
+        raise AssertionError(
+            f"compile emitted {holder['involuntary_remat']} involuntary-"
+            f"remat warning(s) — hand-placed collectives are supposed to "
+            f"make these zero:\n{holder['text'][-2000:]}"
+        )
 
 
 def _cost_analysis_dict(compiled) -> dict | None:
@@ -249,7 +189,8 @@ def _memory_analysis_dict(compiled) -> dict | None:
     return out or None
 
 
-def compiled_stats(jitted_fn, *args, **kwargs) -> dict | None:
+def compiled_stats(jitted_fn, *args, hlo_out: dict | None = None,
+                   **kwargs) -> dict | None:
     """Static analysis record for `jitted_fn` at the given avals (pass
     `jax.ShapeDtypeStruct`s or arrays). Returns None when lowering fails;
     individual analyses a backend lacks come back as None fields.
@@ -257,6 +198,11 @@ def compiled_stats(jitted_fn, *args, **kwargs) -> dict | None:
     Record fields: `flops`, `bytes_accessed`, `transcendentals` (per
     executed step, from cost_analysis), `memory` (memory_analysis sizes),
     `collectives` ({op: {count, bytes}} from the optimized HLO).
+
+    `hlo_out`: optional dict that receives the optimized module text under
+    "text" — fit()'s rule-engine pass (analysis/rules.py) reads it so the
+    hlolint verdicts ride the same AOT compile as the stats instead of
+    paying a second lower().
     """
     try:
         compiled = jitted_fn.lower(*args, **kwargs).compile()
@@ -272,7 +218,10 @@ def compiled_stats(jitted_fn, *args, **kwargs) -> dict | None:
             out["transcendentals"] = ca.get("transcendentals")
     out["memory"] = _memory_analysis_dict(compiled)
     try:
-        out["collectives"] = collective_bytes(compiled.as_text())
+        text = compiled.as_text()
+        if hlo_out is not None:
+            hlo_out["text"] = text
+        out["collectives"] = collective_bytes(text)
     except Exception:
         pass
     return out

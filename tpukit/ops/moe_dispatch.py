@@ -316,7 +316,10 @@ def expected_a2a(cfg, data_size: int, expert_size: int, global_batch: int,
                  seq: int, backend: str | None = None) -> dict | None:
     """Closed-form per-device all-to-all payload of the a2a dispatch — what
     the optimized HLO of one step must show (the audit side of
-    hand-scheduling the collective).
+    hand-scheduling the collective). Round 16: reaches the hlolint rule
+    engine through `ExpertParallel.dispatch_comm` →
+    `analysis.plan.train_comm_plan` (DESIGN.md §15); the `wire` marker
+    below doubles as the wire-upcast rule's declared payload dtype.
 
     Per layer each device moves its `[E, B_local, C, D]` buffer out and the
     results back: 2 all_to_alls forward, and — because the formulation is

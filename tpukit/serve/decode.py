@@ -360,7 +360,10 @@ def decode_step_comm(cfg: gpt.GPTConfig, mesh, slots: int, top_k: int = 0,
     Byte counts are RESULT payloads, the convention
     `obs.xla.collective_bytes` reports. On XLA:CPU the float wire is
     f32 (the round-12 `wire_itemsize` lesson): audit with a f32
-    compute dtype for exact equality on any backend.
+    compute dtype for exact equality on any backend. Round 16:
+    `analysis.plan.decode_comm_plan` wraps this closed form as an
+    EXHAUSTIVE CommPlan (measured == expected, nothing else tolerated)
+    for the hlolint rule engine (DESIGN.md §15).
 
     `paged=True` (round 15) extends the audit to the paged gather: the
     page pools shard heads over `model` and are REPLICATED across `data`,

@@ -310,7 +310,11 @@ class Strategy:
         nothing is compressed. The audit number fit()'s xla record, the
         multichip dryrun and tests compare against the compiled HLO —
         hand-compressing a collective means being able to predict its
-        bytes (the round-10 dispatch-audit discipline, applied to grads)."""
+        bytes (the round-10 dispatch-audit discipline, applied to grads).
+        Round 16: consumed through `analysis.plan.train_comm_plan`, which
+        folds this and `dispatch_comm` into one CommPlan the rule engine
+        diffs (DESIGN.md §15) — new strategies declare here, the engine
+        audits everywhere."""
         return None
 
     def comm_ops_for(self, cfg: gpt.GPTConfig) -> tuple[str, ...]:

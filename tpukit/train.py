@@ -77,6 +77,7 @@ from tpukit.obs import (
     SpanTimeline,
     SpikeSentinel,
     StepLogger,
+    capture_compiler_stderr,
     compiled_stats,
     format_breakdown,
     format_checksum,
@@ -783,7 +784,14 @@ def _fit_body(
             structs = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), call_args
             )
-            stats = compiled_stats(jitted, *structs)
+            hlo = {}
+            # the AOT compile below is what emits GSPMD's involuntary-
+            # remat warnings — captured here so the lint's remat rule
+            # audits the production compile, not an empty string (a
+            # cache-served compile stays silent; the CI lane runs cold
+            # for exactly that reason)
+            with capture_compiler_stderr() as cap:
+                stats = compiled_stats(jitted, *structs, hlo_out=hlo)
         if stats:
             ops_for = getattr(strategy, "comm_ops_for", None)
             expected = (
@@ -821,6 +829,41 @@ def _fit_body(
                 if gaudit:
                     extra["quant_grad_expected"] = gaudit
                     extra["comm_dtype"] = cfg.comm_dtype
+            # hlolint rule verdicts (round 16, tpukit/analysis): the same
+            # engine the dryrun and tools/hlolint.py run — CommPlan diff,
+            # remat/wire/donation/index-plumbing rules, overlap tally —
+            # summarized onto the record so a report can flag a schedule
+            # regression without recompiling anything. Best-effort like
+            # the rest of telemetry: a lint crash must never take down
+            # the run.
+            if hlo.get("text"):
+                try:
+                    from tpukit.analysis import (
+                        lint_module, parse_hlo,
+                        summarize as lint_summarize, train_comm_plan,
+                    )
+
+                    ids = call_args[1]["input_ids"]
+                    lint_plan = train_comm_plan(
+                        strategy, cfg, param_shapes=state_shapes.params,
+                        global_batch=ids.shape[0], seq=ids.shape[1],
+                        backend=jax.default_backend(),
+                        phase="train" if fn_name == "train_step" else "eval",
+                    )
+                    findings = lint_module(
+                        parse_hlo(hlo["text"]), plan=lint_plan,
+                        compiler_stderr=cap["text"],
+                        backend=jax.default_backend(),
+                        # train_step donates the state (donate_argnums);
+                        # eval_step does not
+                        expect_donated=(
+                            len(jax.tree_util.tree_leaves(state_shapes))
+                            if fn_name == "train_step" else None
+                        ),
+                    )
+                    extra["hlolint"] = lint_summarize(findings)
+                except Exception:
+                    pass
             logger.log(
                 kind="xla", fn=fn_name, strategy=strategy.name,
                 backend=jax.default_backend(),

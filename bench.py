@@ -33,7 +33,12 @@ RSS high-water delta, and the byte-parity bit vs a direct restore. The
 `serving` record (round 14, ROADMAP #1) measures the continuous-batching
 engine (tpukit/serve) against serial per-request cached decode on the
 same seeded synthetic stream: tokens/s (>= 2x is the acceptance bar),
-p50/p99 end-to-end and per-token latency, slot occupancy.
+p50/p99 end-to-end and per-token latency, slot occupancy. The
+`spec_decode` record (round 17, ROADMAP #3) measures speculative
+decoding — induction-trained target, self-spec (fused on-device n-gram)
+and draft-model proposers — vs the vanilla engine on the repetitive
+stream at temperature 0 and 0.8: tokens/s (self-spec t=0 >= 1.3x is the
+bar), acceptance rate, and the appended-tokens/verify histogram.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -677,6 +682,186 @@ def bench_paged_kv(cfg, n_dev, requests=24, max_new=12, slots=4):
     }
 
 
+def _induction_train(cfg, tokenizer, steps, row_len, lr=3e-3, seed=7,
+                     batch=8):
+    """Train `cfg` on tiled-phrase rows — the `repetitive` stream profile
+    as training data — so greedy decode learns induction (continue the
+    repetition). Three details are load-bearing, all measured in
+    round 17: (1) 2+ layers are the induction-head minimum; (2) `row_len`
+    must cover the SERVING position range (prompt + decode budget +
+    verify scratch) — position embeddings beyond the trained range are
+    noise, and greedy continuations wander exactly there (acceptance
+    0.34 vs 0.85 with the range covered); (3) the phrases must come from
+    the DISTRIBUTION the serving stream tiles — short heads of the
+    corpus stories, the templated-traffic family — not uniform random
+    tokens: the acceptance rate is 0.30 (speedup 0.76x, speculation
+    loses) with random-token phrases vs 0.99 (2.1x) in-domain, because
+    greedy continuation of a repetition the model has never seen the
+    token statistics of is exactly where it wanders. The training draws
+    use their own seed, not the stream's — in-domain, not
+    memorize-the-eval. Returns (state, final_loss) — the full train
+    state so `tools/train_induction.py` can checkpoint it for the CI
+    spec serve-smoke; bench rungs read `state.params`."""
+    import optax
+
+    from tools.bench_ladder import setup_step
+    from tpukit.data import synthetic_stories
+
+    # cosine decay to ~0: at a constant lr the greedy loops this probe
+    # depends on stay fragile — the loss bounces around 0.1 and the
+    # acceptance rate with it (measured 0.54..0.85 across retrains); a
+    # decayed finish converges the induction behavior reproducibly
+    step_fn, state, _, _ = setup_step(
+        cfg, lr=optax.cosine_decay_schedule(lr, steps)
+    )
+    rng0 = np.random.RandomState(seed)
+    enc = tokenizer(synthetic_stories(128), truncation=True,
+                    max_length=8)["input_ids"]
+    rows = []
+    while len(rows) < 512:
+        head = enc[rng0.randint(len(enc))]
+        plen = min(int(rng0.randint(2, 5)), len(head))
+        if plen < 2:
+            continue
+        phrase = np.asarray(head[:plen], np.int32)
+        rows.append(np.tile(phrase, -(-(row_len + 1) // plen))[: row_len + 1])
+    data = np.asarray(rows, np.int32)
+    pos = np.ascontiguousarray(np.broadcast_to(
+        np.arange(row_len, dtype=np.int32), (batch, row_len)))
+    rng = np.random.RandomState(0)
+    for _ in range(steps):
+        idx = rng.randint(0, len(data), size=batch)
+        mb = {"input_ids": data[idx, :row_len], "position_ids": pos,
+              "mask": np.zeros((batch, row_len), dtype=bool)}
+        state, loss = step_fn(state, mb, data[idx, 1 : row_len + 1])
+    return state, float(loss)
+
+
+def bench_spec_decode(cfg, n_dev, requests=24, slots=4, max_new=48, k=10):
+    """Speculative decoding vs the vanilla engine (round 17, ROADMAP #3),
+    end to end on the SAME seeded `repetitive` synthetic stream.
+
+    Speculation is an optimization exactly when the target's next tokens
+    are predictable, so the probe first makes them predictable the honest
+    way: it TRAINS the target (and a smaller draft) into the regime
+    templated/structured serving traffic puts a real model in — greedy
+    loops that prompt-lookup drafting predicts (`_induction_train`). A
+    random-init target accepts ~nothing and speculation rightly LOSES;
+    that regime is visible in the CI serve smoke, not benched here.
+
+    Rungs at temperature 0 and 0.8, each proposer vs the vanilla engine
+    (all warm — engines constructed twice, second run measured, the
+    round-14 serving-bench pattern): end-to-end tokens/s, acceptance
+    rate, the appended-tokens-per-verify histogram, and the draft/verify
+    wall split. `speedup` per rung is vs the SAME-temperature vanilla
+    run. The acceptance bar is self-spec (ngram) at temperature 0
+    >= 1.3x: the fused on-device proposal (spec.spec_ngram_step) keeps
+    the host rhythm of one dispatch + one sync per quantum, so the win
+    is k+1 tokens of emission capacity per target forward.
+
+    k=10 because the verify dispatch is FIXED-COST dominated at bench
+    shape on this backend (measured: 4.5 ms at k=8 vs 4.9 ms at k=12,
+    vs 0.9 ms per one-token decode dispatch and the vanilla engine's
+    decode_quantum=4 amortization) — a narrow window (k=6) caps the
+    arithmetic at ~1.1x however high acceptance goes, while the
+    induction-trained target's ~0.97 per-token greedy-match rate keeps
+    the accepted prefix long enough for a wide window to pay."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpukit.data import get_tokenizer
+    from tpukit.model import init_params
+    from tpukit.serve import ServeConfig, ServeEngine, synthetic_request_stream
+
+    tokenizer = get_tokenizer()
+    tokenizer.pad_token_id = 2
+    buckets = (16, 32)
+    # serving positions: bucket 32 + 48 new + k scratch = 86
+    row_len = max(buckets) + max_new + k + 2
+    tgt_cfg = cfg.replace(
+        dim=128, head_dim=32, heads=4, num_layers=4,
+        vocab_size=tokenizer.vocab_size, max_position_embeddings=128,
+        compute_dtype=jnp.float32, num_experts=0,
+    )
+    draft_cfg = tgt_cfg.replace(dim=32, head_dim=16, heads=2, num_layers=2)
+    t0 = time.perf_counter()
+    tgt_state, tgt_loss = _induction_train(tgt_cfg, tokenizer, 900, row_len)
+    params = tgt_state.params
+    draft_state, draft_loss = _induction_train(
+        draft_cfg, tokenizer, 1500, row_len
+    )
+    draft_params = draft_state.params
+    train_s = time.perf_counter() - t0
+    eos = int(tokenizer.eos_token_id)
+    stream = synthetic_request_stream(
+        tokenizer, requests, seed=3, max_new_tokens=max_new,
+        buckets=buckets, stream_profile="repetitive",
+    )
+
+    def run(draft, temperature):
+        serve = ServeConfig(
+            slots=slots, buckets=buckets, max_new_tokens=max_new,
+            temperature=temperature, window_steps=10**9,
+            draft=draft, spec_k=k,
+        )
+        kw = (dict(draft_params=draft_params, draft_cfg=draft_cfg)
+              if draft == "model" else {})
+        ServeEngine(params, tgt_cfg, serve, eos_id=eos, **kw).run(
+            list(stream), max_wall_s=900)  # warm: compiles absorbed
+        # steady state = best of 3 measured runs (the time_windows
+        # min-of-windows convention — this shared CPU shows double-digit
+        # run-to-run variance, and a ratio of two noisy walls is noisier
+        # still); token streams are seed-deterministic, so every run
+        # generates the identical tokens and only the wall moves
+        walls = []
+        for _ in range(3):
+            eng = ServeEngine(params, tgt_cfg, serve, eos_id=eos, **kw)
+            t0 = time.perf_counter()
+            comps = eng.run(list(stream), max_wall_s=900)
+            walls.append(time.perf_counter() - t0)
+        wall = min(walls)
+        gen = sum(c.generated for c in comps)
+        out = dict(tokens_per_sec=round(gen / wall, 1),
+                   wall_s=round(wall, 3),
+                   wall_spread_s=round(max(walls) - wall, 3),
+                   generated_tokens=gen, verify_steps=eng.steps)
+        if draft:
+            s = (eng.last_summary or {}).get("spec") or {}
+            out.update(
+                accept_rate=round(s["accept_rate"], 4)
+                if s.get("accept_rate") is not None else None,
+                proposed=s.get("proposed"), accepted=s.get("accepted"),
+                accepted_hist=s.get("accepted_hist"),
+                draft_s=round((eng.last_summary or {}).get("draft_s", 0.0), 3),
+                verify_s=round((eng.last_summary or {}).get("verify_s", 0.0), 3),
+            )
+        return out
+
+    rec = {
+        "requests": requests, "slots": slots, "spec_k": k,
+        "max_new_tokens": max_new, "buckets": list(buckets),
+        "stream_profile": "repetitive",
+        "train": {
+            "target_loss": round(tgt_loss, 4),
+            "draft_loss": round(draft_loss, 4),
+            "train_s": round(train_s, 1),
+        },
+    }
+    for label, temp in (("t0", 0.0), ("t0.8", 0.8)):
+        van = run("", temp)
+        rung = {"vanilla": van}
+        for d in ("ngram", "model"):
+            r = run(d, temp)
+            r["speedup"] = (round(r["tokens_per_sec"] / van["tokens_per_sec"], 2)
+                            if van["tokens_per_sec"] else None)
+            rung[d] = r
+        rec[label] = rung
+    rec["speedup_ngram_t0"] = rec["t0"]["ngram"]["speedup"]
+    return rec
+
+
 def bench_quant_comm(cfg, n_dev, num_experts=8, steps=8):
     """Quantized-collective ladder (round 12, ROADMAP #2): f32 vs bf16 vs
     int8 `--comm_dtype` on each strategy with hand-wired quantized
@@ -1033,6 +1218,17 @@ def main(argv=None):
         paged_kv_rec = {"error": repr(exc)}
         print(f"paged kv probe failed: {exc!r}", file=sys.stderr)
 
+    # Speculative decoding (round 17, ROADMAP #3): draft-and-verify vs
+    # the vanilla engine on the repetitive stream — tokens/s (>= 1.3x
+    # self-spec at temperature 0 is the bar), acceptance rate, the
+    # appended-tokens/verify histogram, at temperature 0 and 0.8.
+    spec_decode_rec = None
+    try:
+        spec_decode_rec = bench_spec_decode(cfg, n_dev)
+    except Exception as exc:
+        spec_decode_rec = {"error": repr(exc)}
+        print(f"spec decode probe failed: {exc!r}", file=sys.stderr)
+
     # Host input pipeline (round 7): sync data+h2d share vs the depth-2
     # prefetcher's residual stall share, with loss-parity proof.
     host_pipeline, host_pipeline_err = None, None
@@ -1090,6 +1286,7 @@ def main(argv=None):
         "elastic_restore": elastic_restore,
         "serving": serving_rec,
         "paged_kv": paged_kv_rec,
+        "spec_decode": spec_decode_rec,
         "host_pipeline": host_pipeline,
         "host_pipeline_error": host_pipeline_err,
         "obs_overhead": obs_overhead,

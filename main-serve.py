@@ -31,6 +31,18 @@ Paged serving picks a model-only grid (the page pool replicates over
 (`checkpoint.restore_params`: the Adam moments — ~2/3 of the bytes —
 are never read, and any saved world lands at the serving shardings).
 
+Round 17 (ROADMAP #3): `--draft {ngram,model}` turns on SPECULATIVE
+DECODING (tpukit/serve/spec.py) — a proposer guesses `--spec_k` tokens
+per slot per quantum and the target scores all k+1 positions in ONE
+batched forward, rejection sampling keeping the output distribution
+EXACT (greedy output token-identical to vanilla decode). "ngram" is
+self-speculation: on-device prompt-lookup drafting fused into the
+verify program, no second model — near-free, and a big win on
+repetitive/templated traffic (`--stream_profile repetitive`). "model"
+runs a small tpukit GPT draft (`--draft_checkpoint` + `--draft_*` shape
+flags, params-only restore with its own ledger line) with its own
+replicated KV ring. Speculation needs the ring cache (page_size 0).
+
 Run examples:
   python main-serve.py --requests 64 --slots 8 --metrics_log serve.jsonl
   python main-serve.py --checkpoint latest --temperature 0.8 --top_k 40
@@ -38,6 +50,11 @@ Run examples:
       --num_experts 8 --moe_dispatch pallas   # dropless MoE: exact cached
   python main-serve.py --page_size 8 --shared_prefix 16 --requests 128 \\
       --kv_dtype int8 --metrics_log serve.jsonl   # paged + prefix + int8
+  python main-serve.py --draft ngram --spec_k 6 \\
+      --stream_profile repetitive --metrics_log serve.jsonl  # self-spec
+  python main-serve.py --draft model \\
+      --draft_checkpoint ckpts_draft/checkpoint-step000002000.msgpack \\
+      --draft_dim 64 --draft_num_layers 2   # draft-model speculation
 """
 
 import argparse
@@ -83,6 +100,27 @@ def parse_serve_flags(argv=None):
                     "request (the shared-prefix-reuse shape; with "
                     "--page_size the engine skips the shared prefill on "
                     "prefix hits)")
+    ap.add_argument("--stream_profile",
+                    choices=("uniform", "repetitive", "shared_prefix"),
+                    default="uniform",
+                    help="synthetic-stream workload shape: 'repetitive' "
+                    "tiles a short phrase per prompt (where "
+                    "self-speculation wins), 'shared_prefix' gives every "
+                    "request one system prompt (the paged prefix-reuse "
+                    "shape)")
+    # draft model (--draft model): restored params-only like the target,
+    # with its own shape flags — a draft checkpoint is just a smaller
+    # tpukit training run sharing the target's tokenizer
+    ap.add_argument("--draft_checkpoint", type=str, default="",
+                    help="checkpoint PATH for the --draft model proposer "
+                    "(no 'latest' — it would resolve the same shared "
+                    "directory as --checkpoint latest); empty with "
+                    "--draft model serves fresh seeded draft params "
+                    "(smoke/bench mode)")
+    ap.add_argument("--draft_dim", type=int, default=64)
+    ap.add_argument("--draft_head_dim", type=int, default=16)
+    ap.add_argument("--draft_heads", type=int, default=4)
+    ap.add_argument("--draft_num_layers", type=int, default=2)
     # telemetry
     ap.add_argument("--metrics_log", type=str, default="")
     ap.add_argument("--compilation_cache_dir", type=str, default="")
@@ -244,6 +282,75 @@ def main(argv=None):
         if p0:
             print("serving fresh seeded params (no --checkpoint)")
 
+    # ---- the draft model (--draft model, round 17) -----------------------
+    # The draft is restored by the SAME params-only reader as the target,
+    # replicated (its forward is not the audited program — replication
+    # keeps any head count legal whatever the model axis), with its own
+    # kind="ckpt_restore" ledger so the report's restore accounting sees
+    # both reads.
+    draft_params = draft_cfg = None
+    if flags.draft == "model":
+        from jax.sharding import NamedSharding, PartitionSpec
+        from tpukit.model.gpt import init_params as gpt_init_params
+
+        draft_cfg = GPTConfig(
+            dim=flags.draft_dim, head_dim=flags.draft_head_dim,
+            heads=flags.draft_heads, num_layers=flags.draft_num_layers,
+            vocab_size=tokenizer.vocab_size,
+            max_position_embeddings=flags.sequence_length,
+            compute_dtype=cfg.compute_dtype,
+        )
+        d_shapes = jax.eval_shape(
+            partial(gpt_init_params, cfg=draft_cfg),
+            jax.random.PRNGKey(flags.seed),
+        )
+        repl = NamedSharding(mesh, PartitionSpec())
+        d_sharding = jax.tree.map(lambda _: repl, d_shapes)
+        if flags.draft_checkpoint:
+            # path-only, deliberately NO "latest": latest_any() scans one
+            # shared directory, so "latest" here and on --checkpoint would
+            # always resolve to the SAME (newest) save — there is no way
+            # to say "latest draft" vs "latest target" from one ledger
+            d_path = flags.draft_checkpoint
+            if d_path == "latest":
+                raise ValueError(
+                    "--draft_checkpoint takes an explicit path: 'latest' "
+                    "would resolve through the same checkpoint directory "
+                    "as --checkpoint latest and pick the identical "
+                    "(newest) save for both models"
+                )
+            ok, detail = ckpt_lib.verify_checkpoint(d_path)
+            if not ok:
+                raise RuntimeError(
+                    f"--draft_checkpoint {d_path}: failed integrity "
+                    f"verification ({detail})")
+            try:
+                draft_params, d_info = ckpt_lib.restore_params(
+                    d_path, d_shapes, d_sharding
+                )
+            except ValueError as exc:
+                raise ValueError(
+                    f"--draft_checkpoint {d_path}: state structure does "
+                    f"not match the draft shape flags (--draft_dim/"
+                    f"--draft_heads/--draft_num_layers... must equal the "
+                    f"draft training run's). Original error: {exc}"
+                ) from exc
+            rec = dict(kind="ckpt_restore", params_only=True, draft=True,
+                       checkpoint=str(d_path), **d_info)
+            logger.log(**rec)
+            recorder.record("ckpt_restore", params_only=True, draft=True)
+            if p0:
+                print(f"draft model {d_path} (params-only restore: "
+                      f"{d_info['bytes_read']} B read)")
+        else:
+            draft_params = jax.jit(
+                partial(gpt_init_params, cfg=draft_cfg),
+                out_shardings=d_sharding,
+            )(jax.random.PRNGKey(flags.seed + 1))
+            if p0:
+                print("draft model: fresh seeded params "
+                      "(no --draft_checkpoint)")
+
     # ---- the engine + the stream -----------------------------------------
     serve = ServeConfig(
         slots=flags.slots, buckets=buckets,
@@ -252,13 +359,16 @@ def main(argv=None):
         window_steps=flags.window_steps,
         page_size=flags.page_size, num_pages=flags.num_pages,
         kv_dtype=flags.kv_dtype, prefill_chunk=flags.prefill_chunk,
+        draft=flags.draft, spec_k=flags.spec_k, ngram_max=flags.ngram_max,
     )
     engine = ServeEngine(params, cfg, serve, eos_id=int(tokenizer.eos_token_id),
-                         mesh=mesh, logger=logger, recorder=recorder)
+                         mesh=mesh, logger=logger, recorder=recorder,
+                         draft_params=draft_params, draft_cfg=draft_cfg)
     requests = synthetic_request_stream(
         tokenizer, flags.requests, seed=flags.seed,
         max_new_tokens=flags.max_new_tokens, buckets=buckets, qps=flags.qps,
         shared_prefix=flags.shared_prefix,
+        stream_profile=flags.stream_profile,
     )
     t0 = time.perf_counter()
     completions = engine.run(requests)
@@ -279,6 +389,15 @@ def main(argv=None):
                   f"{s.get('admitted', 0)} admissions, "
                   f"{s.get('prefix_pages_reused', 0)} pages of prefill "
                   f"skipped")
+        if serve.draft:
+            sp = (engine.last_summary or {}).get("spec") or {}
+            rate = sp.get("accept_rate")
+            print(f"speculative decoding ({serve.draft}, k={serve.spec_k}): "
+                  f"accepted {sp.get('accepted', 0)}/{sp.get('proposed', 0)} "
+                  f"draft tokens"
+                  + (f" ({100 * rate:.0f}%)" if rate is not None else "")
+                  + f", appended/verify histogram "
+                  f"{sp.get('accepted_hist', [])}")
         if e2e:
             print(f"e2e latency p50 {1e3 * e2e[len(e2e) // 2]:.1f} ms  "
                   f"p99 {1e3 * e2e[min(len(e2e) - 1, int(len(e2e) * 0.99))]:.1f} ms")

@@ -1,0 +1,506 @@
+"""Speculative decoding (tpukit/serve/spec, round 17, ROADMAP #3).
+
+Contracts pinned here:
+  - `_accept_prefix` IS rejection sampling: bit-for-bit against a plain
+    Python loop reference over random windows (greedy and temperature/
+    top-k), including the k=0 degenerate (one vanilla target sample) and
+    the all-reject window (one corrected token from the residual);
+  - distribution EXACTNESS, the whole point: the marginal of the first
+    emitted token equals the target distribution p — not the proposal —
+    for both a smooth sampled proposal and a deterministic one-hot
+    proposer, measured empirically over thousands of keys;
+  - the host `NGramProposer` and the fused on-device `_ngram_propose_row`
+    are the SAME proposer, bit-for-bit, over random and crafted periodic
+    histories;
+  - the ENGINE with speculation on is distribution-exact end to end:
+    greedy spec-decode output is token-identical to the vanilla engine
+    over ragged prompts and mid-stream admit/evict for BOTH proposers
+    (incl. a draft==target run that accepts everything, exercising the
+    multi-token append path), and fixed-seed sampled output at
+    temperature 0.8 + top-k is token-identical to the serial
+    `reference_spec_decode` spelling;
+  - `ServeConfig`/engine construction rejects bad spec configs by NAME
+    (draft+paged, vocab/tokenizer mismatch, missing draft params) instead
+    of shape-erroring at the first verify;
+  - `--stream_profile` reproduces the repetitive / shared-prefix workload
+    shapes from one spelling;
+  - spec telemetry lands in the serve JSONL windows + summary, report.py
+    renders it, and `--min_accept_rate` gates on it (incl. the vacuous
+    no-spec-log failure).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpukit.data import WordTokenizer, synthetic_stories
+from tpukit.model import GPTConfig, init_params
+from tpukit.sampling import _adjust_logits
+from tpukit.serve import ServeConfig, ServeEngine, synthetic_request_stream
+from tpukit.serve.spec import (
+    _SALT_ACCEPT,
+    _SALT_FIX,
+    NGramProposer,
+    _accept_prefix,
+    _ngram_propose_row,
+    reference_spec_decode,
+    spec_ngram_step,
+)
+
+MAX_NEW = 10
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return WordTokenizer(synthetic_stories(64))
+
+
+@pytest.fixture(scope="module")
+def cfg(tok):
+    return GPTConfig(
+        dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=tok.vocab_size,
+        max_position_embeddings=64, compute_dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(jax.random.PRNGKey(1), cfg)
+
+
+# ---------------------------------------------------------------------------
+# _accept_prefix: bit-for-bit against a plain-loop rejection sampler.
+# ---------------------------------------------------------------------------
+
+
+def _ref_accept(logits, draft, q_probs, draft_len, key, cursor,
+                temperature, top_k):
+    """The obvious serial spelling of the acceptance pass — same draw
+    streams as `_accept_prefix`, zero vectorization tricks: walk the
+    draft left to right, accept d_i iff u_i < min(1, p(d_i)/q(d_i)),
+    correct from the residual on the first rejection, bonus-sample from
+    p when everything survives."""
+    logits = np.asarray(logits, np.float64)
+    k = len(draft)
+    if temperature > 0.0:
+        adj = np.asarray(
+            _adjust_logits(jnp.asarray(logits, jnp.float32), temperature,
+                           top_k), np.float64)
+        p = np.exp(adj - adj.max(axis=-1, keepdims=True))
+        p /= p.sum(axis=-1, keepdims=True)
+        accepted = 0
+        for i in range(int(draft_len)):
+            u = float(jax.random.uniform(jax.random.fold_in(
+                jax.random.fold_in(key, int(cursor) + i), _SALT_ACCEPT)))
+            q_d = max(float(q_probs[i, draft[i]]), 1e-30)
+            if u * q_d < p[i, draft[i]]:
+                accepted += 1
+            else:
+                break
+        rejected = accepted < int(draft_len)
+        p_next = p[accepted]
+        if rejected:
+            resid = np.maximum(p_next - np.asarray(q_probs[accepted],
+                                                   np.float64), 0.0)
+            dist = resid / resid.sum() if resid.sum() > 0 else p_next
+        else:
+            dist = p_next
+        logd = np.where(dist > 0.0, np.log(np.maximum(dist, 1e-30)), -np.inf)
+        fix = int(jax.random.categorical(
+            jax.random.fold_in(jax.random.fold_in(
+                key, int(cursor) + accepted), _SALT_FIX),
+            jnp.asarray(logd, jnp.float32)))
+    else:
+        am = np.argmax(logits, axis=-1)
+        accepted = 0
+        for i in range(int(draft_len)):
+            if draft[i] == am[i]:
+                accepted += 1
+            else:
+                break
+        fix = int(am[accepted])
+    return accepted, list(draft[:accepted]) + [fix]
+
+
+@pytest.mark.parametrize(
+    "temperature,top_k",
+    [(0.0, 0), (0.8, 0), (0.8, 5)],
+    ids=["greedy", "t0.8", "t0.8_topk"],
+)
+def test_accept_prefix_matches_loop_reference(temperature, top_k):
+    """Random verify windows (random target logits, random smooth
+    proposal, random draft tokens, every draft_len 0..k): the vectorized
+    `_accept_prefix` must agree with the serial loop on the accepted
+    length AND every emitted token — the accepted prefix plus the
+    corrected/bonus sample."""
+    rng = np.random.RandomState(0)
+    k, v = 4, 12
+    for trial in range(8):
+        logits = rng.randn(k + 1, v).astype(np.float32) * 2.0
+        q = rng.dirichlet(np.ones(v), size=k).astype(np.float32)
+        draft = rng.randint(0, v, size=k).astype(np.int32)
+        for dlen in range(k + 1):
+            key = jax.random.PRNGKey(100 + trial)
+            cursor = int(rng.randint(1, 30))
+            acc, toks = _accept_prefix(
+                jnp.asarray(logits), jnp.asarray(draft), jnp.asarray(q),
+                jnp.int32(dlen), key, jnp.int32(cursor), temperature, top_k,
+            )
+            acc, toks = int(acc), np.asarray(toks)
+            ref_acc, ref_toks = _ref_accept(
+                logits, draft, q, dlen, key, cursor, temperature, top_k)
+            assert acc == ref_acc, (trial, dlen)
+            np.testing.assert_array_equal(
+                toks[: acc + 1], ref_toks, err_msg=f"trial {trial} dlen {dlen}")
+
+
+def test_accept_prefix_k0_degenerate():
+    """draft_len == 0 (the proposer had nothing): exactly one target
+    sample — greedy argmax at the first window position, or a p-sample
+    through the correction stream — i.e. a vanilla decode step."""
+    rng = np.random.RandomState(1)
+    logits = rng.randn(4, 9).astype(np.float32)
+    draft = np.zeros((3,), np.int32)
+    q = np.full((3, 9), 1 / 9, np.float32)
+    acc, toks = _accept_prefix(
+        jnp.asarray(logits), jnp.asarray(draft), jnp.asarray(q),
+        jnp.int32(0), jax.random.PRNGKey(0), jnp.int32(5), 0.0, 0)
+    assert int(acc) == 0 and int(toks[0]) == int(np.argmax(logits[0]))
+    # sampled: still exactly one token, drawn from p[0] (checked
+    # distributionally in test_first_token_distribution_exact)
+    acc, toks = _accept_prefix(
+        jnp.asarray(logits), jnp.asarray(draft), jnp.asarray(q),
+        jnp.int32(0), jax.random.PRNGKey(0), jnp.int32(5), 0.8, 0)
+    assert int(acc) == 0 and 0 <= int(toks[0]) < 9
+
+
+def test_accept_prefix_all_reject_residual():
+    """A proposer that is always wrong: one-hot q at a token the target
+    gives ZERO adjusted mass (outside top-k) rejects every position and
+    emits exactly ONE corrected token — and because the residual
+    max(p - q, 0) zeroes the proposed token, the correction can never
+    re-emit it."""
+    rng = np.random.RandomState(2)
+    k, v = 3, 10
+    logits = rng.randn(k + 1, v).astype(np.float32)
+    bad = int(np.argmin(logits[0]))  # outside top_k=2 by construction
+    draft = np.full((k,), bad, np.int32)
+    q = np.asarray(jax.nn.one_hot(draft, v), np.float32)
+    for seed in range(32):
+        acc, toks = _accept_prefix(
+            jnp.asarray(logits), jnp.asarray(draft), jnp.asarray(q),
+            jnp.int32(k), jax.random.PRNGKey(seed), jnp.int32(7), 0.8, 2)
+        assert int(acc) == 0
+        assert int(toks[0]) != bad
+        # with top_k=2 the correction must be one of the two survivors
+        assert int(toks[0]) in np.argsort(logits[0] / 0.8)[-2:]
+
+
+def test_first_token_distribution_exact():
+    """THE exactness theorem, measured: over many keys, the marginal of
+    the first emitted token equals the TARGET distribution p — for a
+    smooth proposal sampled from q, and for the deterministic one-hot
+    proposer (the n-gram case) — even though q is deliberately far from
+    p. This is what licenses speculation as an optimization rather than
+    a model change."""
+    n, v, temperature = 20000, 8, 1.0
+    rng = np.random.RandomState(3)
+    logits = rng.randn(2, v).astype(np.float32) * 1.5
+    p = np.asarray(jax.nn.softmax(jnp.asarray(logits[0]) / temperature))
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(9), i))(
+        jnp.arange(n))
+    cursors = jnp.full((n,), 11, jnp.int32)
+
+    def first_token(draft, q):
+        _, toks = jax.vmap(
+            lambda key, cur, d: _accept_prefix(
+                jnp.asarray(logits), d, jnp.asarray(q), jnp.int32(1), key,
+                cur, temperature, 0)
+        )(keys, cursors, draft)
+        return np.asarray(toks[:, 0])
+
+    # (a) smooth q, draft ~ q per trial (an independent stream)
+    q = rng.dirichlet(np.ones(v)).astype(np.float32)[None, :]
+    draft = jax.vmap(
+        lambda i: jax.random.categorical(
+            jax.random.fold_in(jax.random.PRNGKey(77), i),
+            jnp.log(jnp.asarray(q[0])))
+    )(jnp.arange(n)).astype(jnp.int32)[:, None]
+    emp = np.bincount(first_token(draft, q), minlength=v) / n
+    assert np.abs(emp - p).max() < 0.02, (emp, p)
+    # (b) deterministic proposer: one-hot q at a fixed (wrong-ish) token
+    d0 = int(np.argsort(p)[v // 2])
+    q1 = np.asarray(jax.nn.one_hot([d0], v), np.float32)
+    draft1 = jnp.full((n, 1), d0, jnp.int32)
+    emp1 = np.bincount(first_token(draft1, q1), minlength=v) / n
+    assert np.abs(emp1 - p).max() < 0.02, (emp1, p)
+    # the test has power: q itself is far from p
+    assert np.abs(np.asarray(q[0]) - p).max() > 0.05
+    assert np.abs(np.asarray(q1[0]) - p).max() > 0.05
+
+
+# ---------------------------------------------------------------------------
+# The n-gram proposer: host and device are the SAME proposer.
+# ---------------------------------------------------------------------------
+
+
+def test_ngram_host_device_parity():
+    """`_ngram_propose_row` (the fused on-device spelling) must propose
+    bit-for-bit what the host `NGramProposer` proposes — random
+    small-alphabet histories (recurrences likely), pure periodic tails,
+    and recurrence-free histories (the dlen=0 degenerate). Entries at or
+    beyond the cursor are garbage on purpose: the device match must
+    never consult them (the engine's buffer rows carry pad there)."""
+    k, max_ngram, w = 4, 3, 24
+    prop = NGramProposer(k, max_ngram=max_ngram)
+    rng = np.random.RandomState(4)
+    cases = []
+    for _ in range(12):
+        h = rng.randint(0, 5, size=w).astype(np.int32)
+        cases.append((h, int(rng.randint(3, w))))
+    cases.append((np.tile([7, 8, 9], 8).astype(np.int32), 18))  # periodic
+    cases.append((np.tile([3, 4], 12).astype(np.int32), 20))  # short period
+    cases.append((np.arange(w).astype(np.int32), 15))  # no recurrence
+    for h, cur in cases:
+        want = prop.propose(h[:cur])
+        dirty = h.copy()
+        dirty[cur:] = rng.randint(0, 99, size=w - cur)  # provably unread
+        draft, dlen = _ngram_propose_row(
+            jnp.asarray(dirty), jnp.int32(cur), k=k, max_ngram=max_ngram)
+        dlen = int(dlen)
+        got = list(np.asarray(draft)[:dlen])
+        assert got == want and dlen in (0, k), (h[:cur].tolist(), cur)
+
+
+def test_ngram_proposer_periodic_wrap():
+    """A period-p loop proposes the full k continuation tokens however
+    small p is (the wrap rule): without it, a proposal could never
+    exceed p tokens — and on repetitive streams that is the whole win."""
+    prop = NGramProposer(6, max_ngram=3)
+    assert prop.propose([5, 6, 5, 6, 5, 6]) == [5, 6, 5, 6, 5, 6]
+    assert prop.propose([1, 2, 3, 1, 2, 3, 1]) == [2, 3, 1, 2, 3, 1]
+    assert prop.propose([1, 2, 3, 4, 5]) == []  # nothing recurs
+    with pytest.raises(ValueError, match="k >= 1"):
+        NGramProposer(0)
+
+
+# ---------------------------------------------------------------------------
+# Engine end-to-end: greedy token-identical to vanilla, sampled identical
+# to the serial reference — over ragged prompts and mid-stream admit/evict.
+# ---------------------------------------------------------------------------
+
+
+def _engine_run(params, cfg, tok, reqs, serve, **kw):
+    eng = ServeEngine(params, cfg, serve, eos_id=int(tok.eos_token_id), **kw)
+    comps = eng.run(list(reqs), max_wall_s=300)
+    return eng, {c.rid: c for c in comps}
+
+
+@pytest.mark.parametrize("draft", ["ngram", "model"])
+def test_engine_greedy_spec_equals_vanilla(tok, cfg, params, draft):
+    """Greedy spec-decode must be TOKEN-IDENTICAL to the vanilla engine
+    on the same stream — 8 ragged requests through 3 slots forces
+    mid-stream eviction + slot reuse + admissions while other slots are
+    mid-verify. The repetitive profile gives the n-gram proposer real
+    acceptances, so the multi-token append path is exercised, not just
+    the reject-everything fallback."""
+    reqs = synthetic_request_stream(tok, 8, seed=3, max_new_tokens=MAX_NEW,
+                                    buckets=(8, 16),
+                                    stream_profile="repetitive")
+    vanilla = ServeConfig(slots=3, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                          window_steps=8)
+    _, want = _engine_run(params, cfg, tok, reqs, vanilla)
+    spec = ServeConfig(slots=3, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                       window_steps=8, draft=draft, spec_k=4)
+    kw = (dict(draft_params=params, draft_cfg=cfg) if draft == "model"
+          else {})
+    eng, got = _engine_run(params, cfg, tok, reqs, spec, **kw)
+    assert want.keys() == got.keys()
+    for rid in want:
+        np.testing.assert_array_equal(got[rid].ids, want[rid].ids,
+                                      err_msg=f"rid {rid}")
+        assert got[rid].reason == want[rid].reason
+    if draft == "model":
+        # draft == target: every greedy proposal matches the argmax, so
+        # the engine must accept ~everything (the bonus-token/full-append
+        # path, k+1 tokens per verify, is what's being exercised)
+        assert eng.spec_accepted == eng.spec_proposed > 0
+        assert sum(eng.spec_hist[:2]) < sum(eng.spec_hist)
+
+
+def test_engine_spec_compile_budget(tok, cfg, params):
+    """Self-speculation compiles ONE fused verify program however many
+    requests/buckets/occupancies the run sweeps — the serve-path
+    compile-budget discipline extended to the spec quantum."""
+    before = spec_ngram_step._cache_size()
+    reqs = synthetic_request_stream(tok, 6, seed=5, max_new_tokens=6,
+                                    buckets=(8, 16),
+                                    stream_profile="repetitive")
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=6,
+                        window_steps=8, draft="ngram", spec_k=3)
+    _engine_run(params, cfg, tok, reqs, serve)
+    assert spec_ngram_step._cache_size() - before <= 1
+
+
+@pytest.mark.parametrize("draft", ["ngram", "model"])
+def test_engine_sampled_spec_matches_serial_reference(tok, cfg, params, draft):
+    """Fixed-seed sampled parity at temperature 0.8 + top-k: the engine's
+    batched spec decode must reproduce the serial one-request
+    `reference_spec_decode` token-for-token per request — the draws are
+    position-keyed off the request key, so batching, quantum boundaries,
+    and mid-stream admit/evict (5 requests through 2 slots) must not
+    change a single token."""
+    k, t, topk = 3, 0.8, 5
+    reqs = synthetic_request_stream(tok, 5, seed=13, max_new_tokens=MAX_NEW,
+                                    buckets=(8, 16),
+                                    stream_profile="repetitive")
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        temperature=t, top_k=topk, window_steps=8,
+                        draft=draft, spec_k=k)
+    kw = (dict(draft_params=params, draft_cfg=cfg) if draft == "model"
+          else {})
+    _, got = _engine_run(params, cfg, tok, reqs, serve, **kw)
+    assert len(got) == 5
+    for req in reqs:
+        want = reference_spec_decode(
+            params, cfg, req.ids, MAX_NEW, int(tok.eos_token_id), k=k,
+            draft=draft, draft_params=params if draft == "model" else None,
+            draft_cfg=cfg if draft == "model" else None,
+            temperature=t, top_k=topk, seed=req.seed)
+        np.testing.assert_array_equal(got[req.rid].ids, want,
+                                      err_msg=f"rid {req.rid}")
+
+
+def test_reference_greedy_matches_vanilla_serial(tok, cfg, params):
+    """The serial reference itself honors exactness: greedy
+    `reference_spec_decode` equals the plain serial cached decode."""
+    from tests.test_serve import _serial_cached
+
+    ids = tok(["One day, "], truncation=True, max_length=8)["input_ids"][0]
+    want = _serial_cached(params, cfg, ids, MAX_NEW, tok.eos_token_id)
+    for draft in ("ngram", "model"):
+        got = reference_spec_decode(
+            params, cfg, ids, MAX_NEW, int(tok.eos_token_id), k=3,
+            draft=draft, draft_params=params if draft == "model" else None,
+            draft_cfg=cfg if draft == "model" else None)
+        np.testing.assert_array_equal(got, want, err_msg=draft)
+
+
+# ---------------------------------------------------------------------------
+# Config validation: bad spec configs fail by NAME at construction.
+# ---------------------------------------------------------------------------
+
+
+def test_serve_config_spec_validation(tok, cfg, params):
+    with pytest.raises(ValueError, match="draft="):
+        ServeConfig(draft="nope")
+    with pytest.raises(ValueError, match="spec_k"):
+        ServeConfig(draft="ngram", spec_k=0)
+    with pytest.raises(ValueError, match="ngram_max"):
+        ServeConfig(draft="ngram", ngram_max=0)
+    with pytest.raises(ValueError, match="ring cache"):
+        ServeConfig(draft="ngram", page_size=8, num_pages=16)
+    serve = ServeConfig(slots=2, buckets=(8,), max_new_tokens=4,
+                        draft="model", spec_k=3)
+    # the scratch tail is part of the physical ring width
+    assert serve.kv_width == serve.padded_width + 3
+    with pytest.raises(ValueError, match="draft_params and draft_cfg"):
+        ServeEngine(params, cfg, serve, eos_id=1)
+    with pytest.raises(ValueError, match="share one tokenizer"):
+        bad = cfg.replace(vocab_size=cfg.vocab_size + 1)
+        ServeEngine(params, cfg, serve, eos_id=1,
+                    draft_params=init_params(jax.random.PRNGKey(0), bad),
+                    draft_cfg=bad)
+    with pytest.raises(ValueError, match="position table"):
+        small = cfg.replace(max_position_embeddings=8)
+        ServeEngine(params, cfg, serve, eos_id=1,
+                    draft_params=init_params(jax.random.PRNGKey(0), small),
+                    draft_cfg=small)
+    with pytest.raises(ValueError, match="draft='model'"):
+        ServeEngine(params, cfg,
+                    ServeConfig(slots=2, buckets=(8,), max_new_tokens=4),
+                    eos_id=1, draft_params=params, draft_cfg=cfg)
+
+
+# ---------------------------------------------------------------------------
+# Stream profiles: one spelling reproduces each workload shape.
+# ---------------------------------------------------------------------------
+
+
+def test_stream_profiles(tok):
+    with pytest.raises(ValueError, match="stream_profile"):
+        synthetic_request_stream(tok, 2, stream_profile="bogus")
+    rep = synthetic_request_stream(tok, 6, seed=5, buckets=(8, 16),
+                                   stream_profile="repetitive")
+    for r in rep:
+        ids = list(r.ids)
+        # every repetitive prompt is a short phrase tiled to length
+        period = next(p for p in range(2, 5)
+                      if all(ids[i] == ids[i % p] for i in range(len(ids))))
+        assert 2 <= period <= 4
+    shared = synthetic_request_stream(tok, 6, seed=5, buckets=(8, 16),
+                                      stream_profile="shared_prefix")
+    # shared_prefix defaults the system prompt to half the largest bucket
+    head = shared[0].ids[:8]
+    assert all(r.ids[:8] == head for r in shared)
+    # profiles are seed-deterministic and distinct from uniform
+    again = synthetic_request_stream(tok, 6, seed=5, buckets=(8, 16),
+                                     stream_profile="repetitive")
+    assert [r.ids for r in rep] == [r.ids for r in again]
+    uni = synthetic_request_stream(tok, 6, seed=5, buckets=(8, 16))
+    assert [r.ids for r in uni] != [r.ids for r in rep]
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: spec counters land in the JSONL, report renders + gates.
+# ---------------------------------------------------------------------------
+
+
+def test_spec_telemetry_jsonl_report_and_gate(tok, cfg, params, tmp_path):
+    import importlib
+
+    from tpukit.obs import StepLogger
+
+    report = importlib.import_module("tools.report")
+    log = tmp_path / "spec.jsonl"
+    logger = StepLogger(str(log))
+    reqs = synthetic_request_stream(tok, 5, seed=8, max_new_tokens=8,
+                                    buckets=(8, 16),
+                                    stream_profile="repetitive")
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=8,
+                        window_steps=4, draft="ngram", spec_k=4)
+    eng, _ = _engine_run(params, cfg, tok, reqs, serve, logger=logger)
+    logger.close()
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    windows = [r for r in recs if r["kind"] == "serve"]
+    summary = [r for r in recs if r["kind"] == "serve_summary"][-1]
+    assert windows
+    for w in windows:
+        sp = w["spec"]
+        assert sp["draft"] == "ngram" and sp["k"] == 4
+        assert len(sp["accepted_hist"]) == 4 + 2
+        assert sp["accepted"] <= sp["proposed"]
+    sp = summary["spec"]
+    assert sp["proposed"] == eng.spec_proposed
+    assert sp["accepted"] == eng.spec_accepted
+    # one histogram entry per live slot-verify, and no verify can append
+    # more than its accepted draft + the corrected/bonus token
+    assert sum(sp["accepted_hist"]) > 0
+    appended = sum(i * h for i, h in enumerate(sp["accepted_hist"]))
+    assert appended <= sp["accepted"] + sum(sp["accepted_hist"])
+    assert summary["verify_s"] > 0
+    text = report.summarize(recs)
+    assert "speculative (ngram, k=4)" in text
+    assert "appended/verify histogram" in text
+    # the gate: passes at 0, fails above the measured rate, and fails
+    # VACUOUSLY (not passes) on a log with no spec summary at all
+    ok, _ = report.check_min_accept_rate(recs, 0.0)
+    assert ok
+    ok, msg = report.check_min_accept_rate(recs, 1.01)
+    assert not ok and "FAIL" in msg
+    ok, msg = report.check_min_accept_rate(
+        [r for r in recs if r["kind"] != "serve_summary"], 0.0)
+    assert not ok and "no serve_summary" in msg

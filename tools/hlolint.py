@@ -63,7 +63,7 @@ def _ensure_env(n_devices: int) -> None:
 
 WORLDS = (
     "ddp_f32", "ddp_int8", "fsdp_f32", "fsdp_int8",
-    "ep_a2a", "ep_int8", "tp_decode", "paged_decode",
+    "ep_a2a", "ep_int8", "tp_decode", "paged_decode", "spec_verify",
 )
 
 # the golden-fixture subset checked into tests/fixtures/hlo/ (ISSUE 12);
@@ -172,12 +172,14 @@ def _decode_world(name: str, n_devices: int) -> dict:
     from tpukit.shardings import TensorParallel
 
     paged = name == "paged_decode"
+    spec = name == "spec_verify"
     cfg = GPTConfig(
         dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=160,
         max_position_embeddings=64, compute_dtype=jnp.float32,
     )
     mesh = create_mesh({"model": 4} if paged else {"data": 2, "model": 4})
     slots, width, page, mp = 4, 24, 8, 3
+    spec_k = 3  # the spec_verify world's draft width (verify window = 4)
     strat = TensorParallel(mesh)
     params = init_params(jax.random.PRNGKey(0), cfg)
     params = jax.tree.map(
@@ -201,9 +203,10 @@ def _decode_world(name: str, n_devices: int) -> dict:
         )
         width = mp * page
     else:
+        # spec_verify over-allocates the verify scratch tail (spec.py)
         cache = jax.tree.map(
             lambda c: jax.device_put(c, sh(P(None, da, "model", None, None))),
-            gpt.init_kv_cache(cfg, slots, width),
+            gpt.init_kv_cache(cfg, slots, width + (spec_k if spec else 0)),
         )
     buf = jax.device_put(np.zeros((slots, width), np.int32), sh(P(da, None)))
     cursors = jax.device_put(np.full((slots,), 5, np.int32), sh(P(da)))
@@ -211,15 +214,26 @@ def _decode_world(name: str, n_devices: int) -> dict:
     limits = jax.device_put(np.full((slots,), 12, np.int32), sh(P(da)))
     keys = jax.device_put(np.zeros((slots, 2), np.uint32), sh(P(da, None)))
     with capture_compiler_stderr() as cap:
-        compiled = decode_step.lower(
-            params, cfg, buf, cache, cursors, active, limits, keys,
-            1, 0.0, 0, mesh,
-        ).compile()
+        if spec:
+            # the FUSED self-speculation program (on-device n-gram
+            # proposal + verify) — the one production dispatches
+            from tpukit.serve.spec import spec_ngram_step
+
+            compiled = spec_ngram_step.lower(
+                params, cfg, buf, cache, cursors, active, limits, keys,
+                1, 0.0, 0, k=spec_k, max_ngram=3, mesh=mesh,
+            ).compile()
+        else:
+            compiled = decode_step.lower(
+                params, cfg, buf, cache, cursors, active, limits, keys,
+                1, 0.0, 0, mesh,
+            ).compile()
     return {
         "name": name,
         "text": compiled.as_text(),
         "stderr": cap["text"],
-        "plan": decode_comm_plan(cfg, mesh, slots, top_k=0, paged=paged),
+        "plan": decode_comm_plan(cfg, mesh, slots, top_k=0, paged=paged,
+                                 verify_tokens=spec_k + 1 if spec else 1),
         # the serve jits deliberately do NOT donate (jaxlib deserialized-
         # executable mis-alias, serve/decode.py) — nothing to expect
         "expect_donated": None,
@@ -232,7 +246,7 @@ def build_world(name: str, n_devices: int) -> dict:
     {name, text, stderr, plan, expect_donated, comm_dtype}."""
     if name not in WORLDS:
         raise SystemExit(f"unknown world {name!r} — known: {', '.join(WORLDS)}")
-    if name in ("tp_decode", "paged_decode"):
+    if name in ("tp_decode", "paged_decode", "spec_verify"):
         return _decode_world(name, n_devices)
     return _train_world(name, n_devices)
 

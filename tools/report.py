@@ -35,11 +35,15 @@ admit/evict counts, prefill/decode/sync wall split, latency percentiles)
 and "serve_summary" (whole-run serving headline) rendered as a
 "== serving ==" section, bench.py's `serving` record (continuous
 batching vs serial per-request decode on the same stream), and the
-`--min_serve_tps` CI gate. This tool needs NOTHING but
+`--min_serve_tps` CI gate. Round-17 speculative decoding adds the spec
+block on serve windows/summaries (acceptance rate, accepted-tokens
+histogram, draft/verify wall split) and the `--min_accept_rate` gate.
+This tool needs NOTHING but
 the file — no jax import, so it runs anywhere the log was copied to.
 
 Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
                                         [--min_serve_tps 100]
+                                        [--min_accept_rate 0.3]
 """
 
 from __future__ import annotations
@@ -432,6 +436,23 @@ def summarize(records: list[dict]) -> str:
               f"{r.get('prefix_pages_reused', 0)} pages skipped"
               + (f"   admit hit/cold {hit_s * 1e3:.1f}/{cold_s * 1e3:.1f} ms"
                  if hit_s is not None and cold_s is not None else ""))
+        # round-17 speculative decoding: acceptance health + the
+        # draft/verify wall split (fields only present on --draft runs)
+        sp = r.get("spec")
+        if isinstance(sp, dict):
+            rate = sp.get("accept_rate")
+            w(f"  speculative ({sp.get('draft', '?')}, k={sp.get('k', '?')}): "
+              f"accepted {sp.get('accepted', 0)}/{sp.get('proposed', 0)} "
+              f"draft tokens"
+              + (f" ({100 * rate:.0f}%)" if rate is not None else "")
+              + (f"   draft {r.get('draft_s', 0):.2f}s / verify "
+                 f"{r.get('verify_s', 0):.2f}s"))
+            hist = sp.get("accepted_hist")
+            if hist:
+                total = max(sum(hist), 1)
+                w("  appended/verify histogram: "
+                  + "  ".join(f"{i}:{100 * h / total:.0f}%"
+                              for i, h in enumerate(hist)))
     if serve_wins:
         occ = [r["occupancy"] for r in serve_wins if r.get("occupancy") is not None]
         tps = [r["tokens_per_sec"] for r in serve_wins if r.get("tokens_per_sec")]
@@ -659,6 +680,29 @@ def check_min_serve_tps(records: list[dict], threshold: float) -> tuple[bool, st
     )
 
 
+def check_min_accept_rate(records: list[dict], threshold: float) -> tuple[bool, str]:
+    """Speculative-decoding health gate (`--min_accept_rate`, round 17):
+    the run's `kind="serve_summary"` spec acceptance rate must reach
+    `threshold`. Returns (ok, message) — a log without a spec summary
+    fails, so the gate can't pass vacuously when someone drops `--draft`
+    from the smoke invocation."""
+    sums = [r for r in _rows(records, "serve_summary")
+            if isinstance(r.get("spec"), dict)
+            and r["spec"].get("accept_rate") is not None]
+    if not sums:
+        return False, ("--min_accept_rate: no serve_summary with a spec "
+                       "accept_rate in the log (was the run --draft'ed?)")
+    sp = sums[-1]["spec"]
+    rate = sp["accept_rate"]
+    verdict = "OK" if rate >= threshold else "FAIL"
+    return rate >= threshold, (
+        f"--min_accept_rate {verdict}: {rate:.3f} "
+        f"({sp.get('accepted', 0)}/{sp.get('proposed', 0)} draft tokens, "
+        f"{sp.get('draft', '?')} k={sp.get('k', '?')}; "
+        f"threshold {threshold:.3f})"
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("log", help="metrics JSONL written via --metrics_log")
@@ -671,6 +715,12 @@ def main(argv=None) -> int:
         "--min_serve_tps", type=float, default=None, metavar="TOKENS_PER_SEC",
         help="assert the serve_summary tokens/s >= this (exit 2 below it) "
         "— the serving-throughput regression gate for CI",
+    )
+    ap.add_argument(
+        "--min_accept_rate", type=float, default=None, metavar="FRACTION",
+        help="assert the serve_summary speculative-decoding acceptance "
+        "rate >= FRACTION (exit 2 below it, or when the log has no spec "
+        "summary) — the draft-health regression gate for CI",
     )
     args = ap.parse_args(argv)
     records = load(args.log)
@@ -685,6 +735,10 @@ def main(argv=None) -> int:
         rc = rc if ok else 2
     if args.min_serve_tps is not None:
         ok, msg = check_min_serve_tps(records, args.min_serve_tps)
+        print(msg, file=sys.stdout if ok else sys.stderr)
+        rc = rc if ok else 2
+    if args.min_accept_rate is not None:
+        ok, msg = check_min_accept_rate(records, args.min_accept_rate)
         print(msg, file=sys.stdout if ok else sys.stderr)
         rc = rc if ok else 2
     return rc

@@ -1,0 +1,77 @@
+"""Train-and-checkpoint a tiny induction target for the spec serve-smoke.
+
+Speculation is an optimization exactly when the target's next tokens are
+predictable; a random-init target accepts ~nothing and the
+`--min_accept_rate` CI gate would be unpassable (or vacuous). This tool
+puts a checkpoint in the regime structured/templated serving traffic
+puts a real model in: it trains the SAME tiled-phrase rows the
+`repetitive` stream profile generates (`bench._induction_train` — one
+spelling shared with the `spec_decode` bench record) and saves a
+standard tpukit checkpoint that `main-serve.py --checkpoint` restores
+params-only, so the CI lane exercises the real cold-start path:
+
+    python tools/train_induction.py --dim 64 --num_layers 2 \
+        --steps 400 --out ckpt_induction
+    python main-serve.py --dim 64 --num_layers 2 \
+        --checkpoint "$(ls -d ckpt_induction/checkpoint-step*)" \
+        --draft ngram --stream_profile repetitive ...
+
+Shape flags MUST match the serving invocation's (the params-only reader
+verifies structure); `--row_len` must cover the serving position range
+(largest bucket + max_new_tokens + spec_k — the bench docstring's
+lesson: positions beyond the trained range decode noise and acceptance
+collapses).
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--head_dim", type=int, default=16)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--num_layers", type=int, default=2)
+    ap.add_argument("--sequence_length", type=int, default=128,
+                    help="position-table size; must match the serving "
+                    "--sequence_length")
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--row_len", type=int, default=40,
+                    help="training row length — cover largest bucket + "
+                    "max_new_tokens + spec_k of the serving run")
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", type=str, default="ckpt_induction")
+    flags = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from bench import _induction_train
+    from tpukit import checkpoint as ckpt_lib
+    from tpukit.data import get_tokenizer
+    from tpukit.model import GPTConfig
+
+    tokenizer = get_tokenizer()
+    tokenizer.pad_token_id = 2
+    cfg = GPTConfig(
+        dim=flags.dim, head_dim=flags.head_dim, heads=flags.heads,
+        num_layers=flags.num_layers, vocab_size=tokenizer.vocab_size,
+        max_position_embeddings=flags.sequence_length,
+        compute_dtype=jnp.float32,
+    )
+    state, loss = _induction_train(
+        cfg, tokenizer, flags.steps, flags.row_len, lr=flags.lr,
+        seed=flags.seed,
+    )
+    path = ckpt_lib.save_auto(state, flags.out)
+    print(f"induction target: loss {loss:.4f} after {flags.steps} steps "
+          f"-> {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -127,15 +127,22 @@ def train_comm_plan(strategy, cfg, *, param_shapes=None, global_batch=None,
 
 
 def decode_comm_plan(cfg, mesh, slots: int, top_k: int = 0,
-                     paged: bool = False) -> CommPlan:
+                     paged: bool = False, verify_tokens: int = 1) -> CommPlan:
     """The serving decode-step plan: `decode_step_comm`'s closed form as
     an EXHAUSTIVE CommPlan — the compiled step must move these collectives
-    and nothing else (the round-14/15 audit bar, unchanged)."""
+    and nothing else (the round-14/15 audit bar, unchanged).
+    `verify_tokens = spec_k + 1` prices the SPECULATIVE verify step
+    instead (round 17, `serve/spec.verify_step`): same collective counts,
+    every byte term widened by the verify window — the hlolint
+    `spec_verify` world audits it."""
     from tpukit.serve.decode import decode_step_comm
 
-    expected = decode_step_comm(cfg, mesh, slots, top_k=top_k, paged=paged)
+    expected = decode_step_comm(cfg, mesh, slots, top_k=top_k, paged=paged,
+                                verify_tokens=verify_tokens)
+    label = ("spec verify step" if verify_tokens > 1
+             else f"decode step [{'paged' if paged else 'ring'}]")
     return CommPlan(
-        label=f"decode step [{'paged' if paged else 'ring'}]",
+        label=label,
         ops={op: dict(rec) for op, rec in expected.items()},
         wire={},
         exhaustive=True,

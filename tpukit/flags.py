@@ -360,4 +360,22 @@ def add_serve_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--prefill_chunk", type=int, default=0,
                         help="chunked-prefill tokens per dispatch (page "
                         "multiple dividing every bucket); 0 = one page")
+    # Speculative decoding (round 17, tpukit/serve/spec.py) — the output
+    # distribution is EXACT either way: greedy token-identical to vanilla
+    # decode, sampled corrected by rejection sampling.
+    parser.add_argument("--draft", choices=("", "ngram", "model"),
+                        default="",
+                        help="speculative decoding proposer: 'ngram' = "
+                        "self-speculation (on-device prompt-lookup, no "
+                        "second model), 'model' = a small tpukit GPT "
+                        "draft (--draft_checkpoint + --draft_* shape "
+                        "flags); '' = vanilla decode. Requires the ring "
+                        "cache (page_size 0)")
+    parser.add_argument("--spec_k", type=int, default=4,
+                        help="draft tokens proposed per slot per quantum "
+                        "(the verify window is spec_k + 1 wide)")
+    parser.add_argument("--ngram_max", type=int, default=3,
+                        help="longest n-gram the self-speculation "
+                        "proposer matches (falls back through shorter "
+                        "suffixes down to 1)")
     return parser

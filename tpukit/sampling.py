@@ -132,20 +132,33 @@ def _decode_loop_cached(
     return buf, cur
 
 
+def _adjust_logits(last, temperature: float, top_k: int):
+    """The temperature/top-k logits transform every sampler draws from:
+    scale by 1/temperature, then mask everything below the k-th largest
+    to -inf. Factored out of `_sample_next` (round 17) so the speculative
+    verify step (tpukit/serve/spec.py) builds its target distribution
+    from the SAME math — the rejection-sampling correction is only exact
+    against the distribution vanilla sampling actually draws from.
+    `last` is `[..., V]` f32; only `temperature > 0` callers may use it."""
+    scaled = last / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return scaled
+
+
 def _sample_next(last, cur, rng, temperature: float = 0.0, top_k: int = 0):
     """THE sampling spelling — one token from one f32 logits vector
     `last [V]` at cursor `cur`: temperature == 0 is greedy argmax (static
-    branch, `rng` untouched); > 0 scales, optionally top-k-truncates, and
-    draws `categorical(fold_in(rng, cur), ...)`. Every decode loop —
-    serial naive, serial cached, and the serving engine's batched step
-    (which vmaps this over slots) — calls this ONE function, because the
-    cached==uncached and batched==serial parity guarantees are exactly
-    the bit-for-bit agreement of this math across loops."""
+    branch, `rng` untouched); > 0 scales, optionally top-k-truncates
+    (`_adjust_logits`), and draws `categorical(fold_in(rng, cur), ...)`.
+    Every decode loop — serial naive, serial cached, and the serving
+    engine's batched step (which vmaps this over slots) — calls this ONE
+    function, because the cached==uncached and batched==serial parity
+    guarantees are exactly the bit-for-bit agreement of this math across
+    loops."""
     if temperature > 0.0:  # static branch: greedy decode trace unchanged
-        scaled = last / temperature
-        if top_k > 0:
-            kth = jax.lax.top_k(scaled, top_k)[0][-1]
-            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        scaled = _adjust_logits(last, temperature, top_k)
         return jax.random.categorical(jax.random.fold_in(rng, cur), scaled)
     return jnp.argmax(last, axis=-1)
 

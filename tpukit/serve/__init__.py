@@ -5,10 +5,13 @@ whole-batch loop, the TP comm audit) in `decode.py`; the host-side slot
 scheduler, request/completion types, serving telemetry and the synthetic
 stream in `engine.py`; the paged KV cache — page pool + block tables,
 shared-prefix registry, chunked prefill, int8 page payloads (round 15,
-ROADMAP #2) — in `paged.py`. Recipe: `main-serve.py`.
+ROADMAP #2) — in `paged.py`; speculative decoding — draft-and-verify
+with distribution-exact rejection sampling, self-speculation and draft-
+model proposers (round 17, ROADMAP #3) — in `spec.py`.
+Recipe: `main-serve.py`.
 """
 
-from tpukit.serve import paged  # noqa: F401
+from tpukit.serve import paged, spec  # noqa: F401
 from tpukit.serve.decode import (  # noqa: F401
     decode_loop,
     decode_step,
@@ -17,6 +20,7 @@ from tpukit.serve.decode import (  # noqa: F401
     prefill_slots,
 )
 from tpukit.serve.engine import (  # noqa: F401
+    STREAM_PROFILES,
     Completion,
     Request,
     ServeConfig,

@@ -326,7 +326,7 @@ def decode_loop(params, cfg: gpt.GPTConfig, buf, prompt_lens,
 
 
 def decode_step_comm(cfg: gpt.GPTConfig, mesh, slots: int, top_k: int = 0,
-                     paged: bool = False) -> dict:
+                     paged: bool = False, verify_tokens: int = 1) -> dict:
     """Closed-form PER-DEVICE collective expectation for one compiled
     `decode_step` under a (data x model) serving mesh — the round-10/12
     audit discipline applied to the decode path: the compiled HLO's
@@ -374,7 +374,23 @@ def decode_step_comm(cfg: gpt.GPTConfig, mesh, slots: int, top_k: int = 0,
     force GSPMD to reconcile the scatter with version-dependent index
     plumbing this formula refuses to model, so paged + data > 1 raises
     here (and at engine construction) instead of drifting from the HLO.
+
+    `verify_tokens=t > 1` (round 17) prices the SPECULATIVE verify step
+    (`serve/spec.verify_step`, t = spec_k + 1): the same program shape
+    with every activation t positions wide — identical collective COUNTS
+    (the speculation win in comm terms: t tokens of progress per
+    collective round-trip) with every byte term scaled by t. The
+    acceptance math itself (uniform draws, cumprod prefix, residual
+    categorical) runs on the model-replicated logits and adds ZERO
+    collectives — exactly why the logits pin is the one constraint.
+    Speculation runs on the ring only, so `paged` and `verify_tokens>1`
+    are mutually exclusive (ServeConfig enforces the same upstream).
     """
+    if paged and verify_tokens > 1:
+        raise ValueError(
+            "speculative verify (verify_tokens > 1) audits the ring cache "
+            "only — spec + paged is rejected at ServeConfig"
+        )
     d = mesh.shape.get("data", 1)
     m = mesh.shape.get("model", 1)
     if paged and d > 1:
@@ -398,17 +414,18 @@ def decode_step_comm(cfg: gpt.GPTConfig, mesh, slots: int, top_k: int = 0,
             f"does not model"
         )
     n_local = slots // d
-    act = n_local * cfg.dim * jnp.dtype(cfg.compute_dtype).itemsize
-    embed = n_local * cfg.dim * jnp.dtype(cfg.param_dtype).itemsize
+    t = verify_tokens
+    act = n_local * t * cfg.dim * jnp.dtype(cfg.compute_dtype).itemsize
+    embed = n_local * t * cfg.dim * jnp.dtype(cfg.param_dtype).itemsize
     out = {}
     if m > 1:
         out["all-reduce"] = {
             "count": 2 * cfg.num_layers + 1,
             "bytes": 2 * cfg.num_layers * act + embed,
         }
-        logits = n_local * cfg.padded_vocab_size * 4  # f32 sample logits
+        logits = n_local * t * cfg.padded_vocab_size * 4  # f32 sample logits
         out["all-gather"] = {"count": 1, "bytes": logits}
         if top_k > 0 and d > 1:
             out["all-gather"]["count"] += 1
-            out["all-gather"]["bytes"] += slots * cfg.padded_vocab_size * 4
+            out["all-gather"]["bytes"] += slots * t * cfg.padded_vocab_size * 4
     return out

@@ -150,8 +150,48 @@ class ServeConfig:
     # iteration with decode quanta in between, so a long prompt can never
     # stall active slots for more than one chunk's compute.
     prefill_chunk: int = 0
+    # Speculative decoding (round 17, ROADMAP #3; tpukit/serve/spec.py).
+    # "" = vanilla decode quanta. "ngram" = self-speculation: prompt-
+    # lookup drafting from each slot's own history, no second model.
+    # "model" = a small tpukit GPT draft model (pass draft_params /
+    # draft_cfg to the engine). Either way the target scores all
+    # spec_k + 1 positions in ONE batched forward and rejection sampling
+    # keeps the output distribution EXACT: greedy output is token-
+    # identical to vanilla decode, sampled output is an exact target-
+    # distribution sample (spec.py module docstring). Requires the ring
+    # cache (page_size == 0): the multi-token verify write-back does not
+    # fit the paged whole-page write contract this round.
+    draft: str = ""  # "" | "ngram" | "model"
+    # Draft tokens proposed per slot per quantum (the verify window is
+    # spec_k + 1 wide). The KV ring over-allocates this many scratch
+    # positions past `width` so a lane near its limit still writes a full
+    # verify window without update-slice clamping (spec.py docstring).
+    spec_k: int = 4
+    # Longest n-gram the self-speculation proposer matches (it falls back
+    # through shorter suffixes down to 1).
+    ngram_max: int = 3
 
     def __post_init__(self):
+        if self.draft not in ("", "ngram", "model"):
+            raise ValueError(
+                f"draft={self.draft!r} must be '', 'ngram' or 'model'"
+            )
+        if self.draft:
+            if self.spec_k < 1:
+                raise ValueError(
+                    f"spec_k={self.spec_k} must be >= 1 with draft="
+                    f"{self.draft!r} — a 0-token draft is vanilla decode"
+                )
+            if self.ngram_max < 1:
+                raise ValueError(f"ngram_max={self.ngram_max} must be >= 1")
+            if self.page_size:
+                raise ValueError(
+                    f"draft={self.draft!r} requires the ring cache "
+                    f"(page_size=0, got {self.page_size}): the k+1-token "
+                    f"verify write-back is not page-aligned, and the paged "
+                    f"write contract only covers whole pages — speculative "
+                    f"+ paged is a future round (DESIGN.md §16)"
+                )
         if self.slots < 1:
             raise ValueError(f"slots={self.slots} must be >= 1")
         if self.decode_quantum < 1:
@@ -247,17 +287,32 @@ class ServeConfig:
         return self.prefill_chunk or self.page_size
 
     @property
+    def kv_width(self) -> int:
+        """Physical KV-ring width: the logical width plus the spec-decode
+        scratch tail (`spec_k` positions a verify window near the buffer
+        end spills into — never appended, never attended; spec.py)."""
+        return self.padded_width + (self.spec_k if self.draft else 0)
+
+    @property
     def compile_budget(self) -> int:
         """Declared ceiling on serve-path compiles: ONE decode program
         (at this quantum) plus one prefill program per admit size — the
         admit batcher pads group sizes to powers of two precisely so this
         stays a small static set (asserted in tests). Ring prefills
         compile per (bucket, admit size); paged chunked prefills have ONE
-        static chunk width, so only the admit sizes multiply."""
+        static chunk width, so only the admit sizes multiply.
+
+        Speculative decoding swaps the decode program for ONE verify
+        program; the "model" draft adds one draft-propose loop and a
+        second prefill program per (bucket, admit size) — the draft ring
+        is prefilled by the same batched program as the target's."""
         admit_sizes = (self.slots - 1).bit_length() + 1
         if self.paged:
             return 1 + admit_sizes
-        return 1 + len(self.buckets) * admit_sizes
+        prefills = len(self.buckets) * admit_sizes
+        if self.draft == "model":
+            return 2 + 2 * prefills
+        return 1 + prefills
 
 
 @dataclasses.dataclass
@@ -296,13 +351,54 @@ class ServeEngine:
     """
 
     def __init__(self, params, cfg: gpt.GPTConfig, serve: ServeConfig,
-                 eos_id: int, mesh=None, logger=None, recorder=None):
-        if serve.width > cfg.max_position_embeddings:
+                 eos_id: int, mesh=None, logger=None, recorder=None,
+                 draft_params=None, draft_cfg=None):
+        if serve.kv_width > cfg.max_position_embeddings:
             raise ValueError(
-                f"KV ring width {serve.width} (max bucket {max(serve.buckets)}"
-                f" + max_new_tokens {serve.max_new_tokens}) exceeds the "
-                f"position table ({cfg.max_position_embeddings}) — beyond it "
-                f"position lookups silently clamp instead of erroring"
+                f"KV ring width {serve.kv_width} (max bucket "
+                f"{max(serve.buckets)} + max_new_tokens "
+                f"{serve.max_new_tokens}"
+                + (f" + spec_k {serve.spec_k} verify scratch"
+                   if serve.draft else "")
+                + f") exceeds the position table "
+                f"({cfg.max_position_embeddings}) — beyond it position "
+                f"lookups silently clamp instead of erroring"
+            )
+        if serve.draft == "model":
+            if draft_params is None or draft_cfg is None:
+                raise ValueError(
+                    "draft='model' requires draft_params and draft_cfg "
+                    "(a tpukit GPT draft — restore one via "
+                    "checkpoint.restore_params, main-serve.py "
+                    "--draft_checkpoint)"
+                )
+            # Named at construction, not a shape error at the first
+            # verify: the acceptance test compares p and q elementwise
+            # over the logits axis, so the draft must speak the TARGET's
+            # token ids — same tokenizer vocab AND the same padded width.
+            if (draft_cfg.vocab_size != cfg.vocab_size
+                    or draft_cfg.padded_vocab_size != cfg.padded_vocab_size):
+                raise ValueError(
+                    f"draft model vocab (vocab_size "
+                    f"{draft_cfg.vocab_size}, padded "
+                    f"{draft_cfg.padded_vocab_size}) does not match the "
+                    f"target ({cfg.vocab_size}, padded "
+                    f"{cfg.padded_vocab_size}) — draft and target must "
+                    f"share one tokenizer; the rejection-sampling "
+                    f"correction compares their distributions token id "
+                    f"by token id"
+                )
+            if serve.kv_width > draft_cfg.max_position_embeddings:
+                raise ValueError(
+                    f"draft model position table "
+                    f"({draft_cfg.max_position_embeddings}) is smaller "
+                    f"than the KV ring width {serve.kv_width} — the "
+                    f"draft decodes the same positions the target serves"
+                )
+        elif draft_params is not None or draft_cfg is not None:
+            raise ValueError(
+                f"draft_params/draft_cfg passed but ServeConfig.draft="
+                f"{serve.draft!r} — set draft='model' to use them"
             )
         self.params = params
         self.cfg = cfg
@@ -311,6 +407,8 @@ class ServeEngine:
         self.mesh = mesh
         self.logger = logger
         self.recorder = recorder
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
         # lax.top_k rejects k beyond the logits width — clamp like generate()
         self._top_k = min(int(serve.top_k), cfg.padded_vocab_size)
         n, w = serve.slots, serve.padded_width
@@ -384,11 +482,29 @@ class ServeEngine:
         else:
             self.num_pages = 0
             self.allocator = None
-            ring = gpt.init_kv_cache(cfg, n, w)
+            ring = gpt.init_kv_cache(cfg, n, serve.kv_width)
             self.kv_bytes = sum(
                 int(np.prod(c.shape)) * c.dtype.itemsize for c in ring.values()
             )
             self.cache = jax.tree.map(lambda c: place(c, cache_spec), ring)
+        self._slot_spec = slot_spec
+        self.draft_cache = None
+        if serve.draft == "model":
+            # the draft's own ring, same slots/width discipline as the
+            # target's; REPLICATED under a mesh (the draft is small — its
+            # forward is not the audited program, and replication keeps
+            # any head count legal whatever the model axis)
+            self.draft_cache = jax.tree.map(
+                lambda c: place(c, P()),
+                gpt.init_kv_cache(draft_cfg, n, serve.kv_width),
+            )
+        # spec telemetry (round 17): proposed/accepted draft tokens, the
+        # appended-tokens-per-verify histogram (index 0..spec_k+1), and
+        # the host-side snapshot pending the next sync drain
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_hist = [0] * (serve.spec_k + 2) if serve.draft else []
+        self._pending_spec = None
         self.cursors = place(np.zeros((n,), np.int32), slot_spec)
         self.active = place(np.zeros((n,), bool), slot_spec)
         self.limits = place(np.zeros((n,), np.int32), slot_spec)
@@ -407,7 +523,8 @@ class ServeEngine:
         self._gen_total = 0
         self.last_summary: dict | None = None
         # per-window deltas
-        self._win = dict(steps=0, gen0=0, admit0=0, comps0=0, hits0=0)
+        self._win = dict(steps=0, gen0=0, admit0=0, comps0=0, hits0=0,
+                         prop0=0, acc0=0, hist0=list(self.spec_hist))
         self._window_idx = 0
 
     # ---- scheduling ------------------------------------------------------
@@ -470,6 +587,19 @@ class ServeEngine:
                     self._place(plens, P()), self._place(lims, P()),
                     self._place(keys, P()),
                 )
+                if self.serve.draft == "model":
+                    # prefill the DRAFT ring for the same admit batch —
+                    # the same batched program against the draft's
+                    # params/cache; the non-cache outputs are identical
+                    # values to the target call's and are discarded
+                    _, self.draft_cache, *_ = serve_decode.prefill_slots(
+                        self.draft_params, self.draft_cfg, self.buf,
+                        self.draft_cache, self.cursors, self.active,
+                        self.limits, self.keys,
+                        self._place(slots, P()), self._place(rows, P()),
+                        self._place(plens, P()), self._place(lims, P()),
+                        self._place(keys, P()),
+                    )
             self.buckets_used.add(bucket)
             for slot, req, plen in entries:
                 self._lanes[slot] = _Lane(req, now, plen, bucket, active_s=now)
@@ -612,13 +742,97 @@ class ServeEngine:
         self.steps += self.serve.decode_quantum
         self._win["steps"] += self.serve.decode_quantum
 
+    # ---- speculative decoding (round 17, tpukit/serve/spec.py) ----------
+
+    def _spec_step(self) -> None:
+        """One draft-and-verify quantum: propose up to `spec_k` tokens per
+        slot ("draft" span — a host n-gram lookup or the draft model's
+        jitted loop), then score all spec_k+1 positions in ONE batched
+        target forward and accept a per-slot prefix ("verify" span).
+        Counts as ONE step; a verify can append up to spec_k+1 tokens per
+        slot, which is the whole speculation win."""
+        from tpukit.serve import spec as spec_lib
+
+        k, n = self.serve.spec_k, self.serve.slots
+        # lanes live at dispatch (last sync's view): proposal targets and
+        # the telemetry denominator
+        live = np.zeros((n,), bool)
+        for s, lane in self._lanes.items():
+            if lane.phase == "decode":
+                live[s] = True
+        if self.serve.draft == "model":
+            with self.spans.span("draft"):
+                draft_toks, draft_q, self.draft_cache = spec_lib.draft_propose(
+                    self.draft_params, self.draft_cfg, self.buf,
+                    self.draft_cache, self.cursors, self.keys,
+                    k=k, temperature=float(self.serve.temperature),
+                    top_k=self._top_k,
+                )
+                dlen = np.where(live, k, 0).astype(np.int32)
+                draft_len = self._place(
+                    np.full((n,), k, np.int32), self._slot_spec
+                )
+            with self.spans.span("verify"):
+                (self.buf, self.cache, self.cursors, self.active, acc,
+                 napp) = spec_lib.verify_step(
+                    self.params, self.cfg, self.buf, self.cache,
+                    self.cursors, self.active, self.limits, self.keys,
+                    draft_toks, draft_q, draft_len, self.eos_id,
+                    float(self.serve.temperature), self._top_k, k=k,
+                    mesh=self.mesh,
+                )
+        else:
+            # self-speculation: the n-gram proposal is FUSED into the
+            # verify program (spec.spec_ngram_step) — one dispatch and
+            # one sync per quantum, the vanilla step's host rhythm; a
+            # host-side proposer would pay buf D2H + draft H2D + a
+            # second dispatch every quantum
+            with self.spans.span("verify"):
+                (self.buf, self.cache, self.cursors, self.active, acc,
+                 napp, dlen) = spec_lib.spec_ngram_step(
+                    self.params, self.cfg, self.buf, self.cache,
+                    self.cursors, self.active, self.limits, self.keys,
+                    self.eos_id, float(self.serve.temperature),
+                    self._top_k, k=k, max_ngram=self.serve.ngram_max,
+                    mesh=self.mesh,
+                )
+        self._pending_spec = (live, dlen, acc, napp)
+        self.steps += 1
+        self._win["steps"] += 1
+
+    def _drain_spec(self) -> None:
+        """Fold the last verify's device counters into the spec telemetry
+        (called from the sync fetch — the accepted/appended arrays ride
+        the same D2H boundary as the cursors)."""
+        if self._pending_spec is None:
+            return
+        live, dlen, acc, napp = self._pending_spec
+        self._pending_spec = None
+        acc = np.asarray(jax.device_get(acc))
+        napp = np.asarray(jax.device_get(napp))
+        for s in np.flatnonzero(live):
+            self.spec_proposed += int(dlen[s])
+            self.spec_accepted += int(min(acc[s], dlen[s]))
+            self.spec_hist[int(napp[s])] += 1
+
     def _sync_evict(self, now: float) -> None:
         """The per-step host sync: fetch cursors + active flags, retire
         lanes that finished, and account generated tokens. One small D2H
         per step — the price of host-side EOS detection."""
         with self.spans.span("sync"):
-            cur = np.asarray(jax.device_get(self.cursors))
-            act = np.asarray(jax.device_get(self.active))
+            if self._pending_spec is not None:
+                # coalesce the spec counters into the same D2H round trip
+                # (dlen is a device array on the fused ngram path, host
+                # numpy on the model path — device_get passes the latter
+                # through untouched)
+                live, dlen, acc, napp = self._pending_spec
+                cur, act, dlen, acc, napp = map(np.asarray, jax.device_get(
+                    (self.cursors, self.active, dlen, acc, napp)))
+                self._pending_spec = (live, dlen, acc, napp)
+            else:
+                cur, act = map(np.asarray,
+                               jax.device_get((self.cursors, self.active)))
+            self._drain_spec()
         # prefilling paged lanes are act=False by design, not finished
         finished = [
             s for s, lane in self._lanes.items()
@@ -680,12 +894,16 @@ class ServeEngine:
         steps = self._win["steps"]
         # occupancy = slot-step utilization: the fraction of slot x decode-
         # tick capacity this window that actually yielded a token (frozen
-        # finished lanes and drained tails read as idle — honest)
+        # finished lanes and drained tails read as idle — honest). Under
+        # speculation a "step" is one verify dispatch with a per-slot
+        # emission capacity of spec_k + 1, so the denominator widens.
+        cap = (self.serve.spec_k + 1) if self.serve.draft else 1
         rec = dict(
             kind="serve", window=self._window_idx, steps=steps,
             new_tokens=new_tokens,
             tokens_per_sec=(new_tokens / b["total_s"]) if b["total_s"] else None,
-            occupancy=(new_tokens / (self.serve.slots * steps)) if steps else 0.0,
+            occupancy=(new_tokens / (self.serve.slots * steps * cap))
+            if steps else 0.0,
             admitted=self.admitted - self._win["admit0"],
             completed=len(comps), queue_depth=len(self._pending),
             slots=self.serve.slots, window_s=b["total_s"],
@@ -707,6 +925,20 @@ class ServeEngine:
             rec["pages_per_request"] = (
                 float(np.mean([c.pages for c in comps])) if comps else None
             )
+        if self.serve.draft:
+            # the spec health triple (round 17): how much of the draft the
+            # target accepted, the per-verify emission shape, and the
+            # draft/verify wall split (rides the spans already in rec)
+            prop = self.spec_proposed - self._win["prop0"]
+            acc = self.spec_accepted - self._win["acc0"]
+            rec["spec"] = dict(
+                draft=self.serve.draft, k=self.serve.spec_k,
+                proposed=prop, accepted=acc,
+                accept_rate=(acc / prop) if prop else None,
+                accepted_hist=[
+                    h - h0 for h, h0 in zip(self.spec_hist, self._win["hist0"])
+                ],
+            )
         if self.logger is not None:
             self.logger.log(**rec)
         if self.recorder is not None:
@@ -720,6 +952,8 @@ class ServeEngine:
             steps=0, gen0=self._gen_total, admit0=self.admitted,
             comps0=len(self.completions),
             hits0=self.allocator.stats.prefix_hits if self.serve.paged else 0,
+            prop0=self.spec_proposed, acc0=self.spec_accepted,
+            hist0=list(self.spec_hist),
         )
 
     def summary(self, wall_s: float) -> dict:
@@ -733,7 +967,9 @@ class ServeEngine:
             tokens_per_sec=(sum(c.generated for c in comps) / wall_s)
             if wall_s else None,
             mean_occupancy=(
-                sum(c.generated for c in comps) / (self.serve.slots * self.steps)
+                sum(c.generated for c in comps)
+                / (self.serve.slots * self.steps
+                   * ((self.serve.spec_k + 1) if self.serve.draft else 1))
             ) if self.steps else 0.0,
             admitted=self.admitted, evicted_eos=self.evicted["eos"],
             evicted_length=self.evicted["length"],
@@ -748,6 +984,16 @@ class ServeEngine:
         rec["sync_s"] = ep["seconds"].get("sync", 0.0)
         rec["max_live_slots"] = self.max_live
         rec["kv_bytes"] = self.kv_bytes
+        if self.serve.draft:
+            rec["draft_s"] = ep["seconds"].get("draft", 0.0)
+            rec["verify_s"] = ep["seconds"].get("verify", 0.0)
+            rec["spec"] = dict(
+                draft=self.serve.draft, k=self.serve.spec_k,
+                proposed=self.spec_proposed, accepted=self.spec_accepted,
+                accept_rate=(self.spec_accepted / self.spec_proposed)
+                if self.spec_proposed else None,
+                accepted_hist=list(self.spec_hist),
+            )
         if self.serve.paged:
             st = self.allocator.stats
             hit = [c.admit_latency_s for c in comps if c.prefix_pages > 0]
@@ -811,7 +1057,10 @@ class ServeEngine:
                     if wait > 0:
                         time.sleep(min(wait, 0.05))
                 continue
-            self._step()
+            if self.serve.draft:
+                self._spec_step()
+            else:
+                self._step()
             self._sync_evict(time.perf_counter() - t0)
             if self._win["steps"] >= self.serve.window_steps:
                 self._emit_window()
@@ -830,11 +1079,15 @@ class ServeEngine:
         return self.completions
 
 
+STREAM_PROFILES = ("uniform", "repetitive", "shared_prefix")
+
+
 def synthetic_request_stream(tokenizer, n: int, *, seed: int = 0,
                              max_new_tokens: int = 16,
                              buckets=(16, 32), qps: float = 0.0,
                              corpus=None, lengths=None,
-                             shared_prefix: int = 0) -> list[Request]:
+                             shared_prefix: int = 0,
+                             stream_profile: str = "uniform") -> list[Request]:
     """Seeded synthetic request stream: prompts cut from the offline
     fixture corpus at seeded lengths spanning the bucket set, arrivals
     all-at-once (qps=0, an offered-load saturation test) or spaced by a
@@ -844,6 +1097,19 @@ def synthetic_request_stream(tokenizer, n: int, *, seed: int = 0,
     bench uses it so the SERIAL baseline's per-prompt-length compiles
     stay bounded; the engine is bucket-bounded either way).
 
+    `stream_profile` (round 17) names the workload SHAPE so a bench or
+    test run is reproducible from one spelling (`--stream_profile` in
+    main-serve.py):
+
+      - "uniform" (default): the original per-request corpus cuts.
+      - "repetitive": each prompt is a short seeded phrase (2-4 tokens)
+        TILED to its target length — the structured/templated traffic
+        shape where self-speculation (n-gram drafting, spec.py) wins:
+        histories recur by construction, so prompt-lookup proposals land.
+      - "shared_prefix": every request shares one system prompt; uses
+        `shared_prefix` (defaulting it to half the largest bucket when
+        unset) — the paged prefix-reuse shape (round 15).
+
     `shared_prefix > 0` prepends the SAME `shared_prefix`-token system
     prompt (cut from the corpus head) to every request — the
     millions-of-users-one-system-prompt shape that paged prefix reuse
@@ -851,8 +1117,15 @@ def synthetic_request_stream(tokenizer, n: int, *, seed: int = 0,
     truncated to the largest bucket."""
     from tpukit.data import synthetic_stories
 
+    if stream_profile not in STREAM_PROFILES:
+        raise ValueError(
+            f"stream_profile={stream_profile!r} must be one of "
+            f"{STREAM_PROFILES}"
+        )
     rng = np.random.RandomState(seed)
     corpus = corpus if corpus is not None else synthetic_stories(max(64, n))
+    if stream_profile == "shared_prefix" and shared_prefix <= 0:
+        shared_prefix = max(buckets) // 2
     prefix: list[int] = []
     if shared_prefix > 0:
         prefix = list(tokenizer(
@@ -867,6 +1140,10 @@ def synthetic_request_stream(tokenizer, n: int, *, seed: int = 0,
         else:
             target = int(rng.randint(4, max(buckets) + 1))
         ids = tokenizer([text], truncation=True, max_length=target)["input_ids"][0]
+        if stream_profile == "repetitive":
+            phrase = list(ids)[: int(rng.randint(2, 5))]
+            reps = -(-target // max(len(phrase), 1))
+            ids = (phrase * reps)[:target]
         ids = (prefix + list(ids))[: max(buckets)]
         if qps > 0:
             t += float(rng.exponential(1.0 / qps))

@@ -1005,6 +1005,154 @@ def bench_quant_comm(cfg, n_dev, num_experts=8, steps=8):
     return rows
 
 
+def bench_comm_overlap(cfg, n_dev, num_experts=8, steps=8):
+    """Overlap-scheduled collectives ladder (round 18, ROADMAP #5):
+    step-time at f32 (serial) vs int8 (serial — the round-12 wire cut)
+    vs int8 + --grad_buckets 4 (the overlap schedule) on the DDP, FSDP
+    and EP worlds, so the wire cut and the overlap win are SEPARATELY
+    visible. Each rung compiles cold under a compiler-stderr capture and
+    reports:
+
+      - step_time_s (best window / steps) and tokens/s/chip — the
+        wall-clock observable. NOTE the honest caveat: on CPU virtual
+        devices the collectives are loopback memcpys, so the overlap
+        rung's wall win is noise-bounded; the schedule PROPERTY is the
+        gated signal (below), the times are the observable a real
+        multi-chip run compares;
+      - the promoted hlolint `overlap` verdict on the overlap rung:
+        declared vs overlappable bucket wires and `overlap_frac` =
+        overlappable/declared (1.0 = every bucket wire independently
+        schedulable) — the number tools/report.py's --min_overlap_frac
+        gate checks;
+      - bytes_match: measured collectives == the per-bucket closed form;
+      - involuntary-remat warnings (zero = schedule intact, cold only);
+      - final loss + delta vs the rung's f32 serial baseline (the
+        round-12 tolerance-gate number; the f32 bucket schedule itself
+        is bit-identical across bucket counts, tests/test_overlap.py).
+    """
+    import math
+
+    import jax
+
+    from tools.bench_ladder import make_batch, setup_step, time_windows
+    from tpukit.analysis import (
+        collective_summary, lint_module, parse_hlo, summarize,
+        train_comm_plan,
+    )
+    from tpukit.mesh import create_mesh
+    from tpukit.obs import capture_compiler_stderr
+    from tpukit.shardings import DataParallel, ExpertParallel, FSDP
+
+    seq = cfg.max_position_embeddings
+    batch = 32 * n_dev
+    expert = math.gcd(n_dev, num_experts)
+    backend = jax.default_backend()
+    struct = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+
+    rungs = [
+        ("ddp", lambda: DataParallel(create_mesh({"data": n_dev}))),
+        ("fsdp", lambda: FSDP(create_mesh({"data": n_dev}))),
+        ("ep", lambda: ExpertParallel(
+            create_mesh({"data": n_dev // expert, "expert": expert}),
+            dispatch="a2a")),
+    ]
+    rows = []
+    for name, strat_fn in rungs:
+        f32_loss = f32_step = None
+        for dtype, buckets in (("f32", 0), ("int8", 0), ("int8", 4)):
+            try:
+                c = cfg.replace(
+                    comm_dtype=dtype, grad_buckets=buckets,
+                    num_experts=num_experts if name == "ep" else 0,
+                )
+                strat = strat_fn()
+                strat.validate_config(c)
+                b, t = make_batch(
+                    np.random.RandomState(5), cfg.vocab_size, batch, seq - 1
+                )
+                with capture_compiler_stderr() as cap:
+                    step, state, shapes, _ = setup_step(c, strat)
+                    compiled = step.lower(
+                        shapes, jax.tree.map(struct, b), struct(t)
+                    ).compile()
+                # render + parse ONCE (the round-16 discipline): the byte
+                # audit and the lint share one module
+                module = parse_hlo(compiled.as_text())
+                coll = collective_summary(module)
+                plan = train_comm_plan(
+                    strat, c, param_shapes=shapes.params,
+                    global_batch=batch, seq=seq - 1, backend=backend,
+                )
+                exact = None
+                if plan is not None and plan.ops:
+                    exact = all(
+                        (coll.get(op) or {"count": 0, "bytes": 0}) == rec
+                        for op, rec in plan.ops.items()
+                    )
+                overlap = None
+                if plan is not None and plan.overlap:
+                    verdict = summarize(lint_module(
+                        module, plan=plan,
+                        compiler_stderr=cap["text"], backend=backend,
+                    ))
+                    gate = verdict.get("overlap_gate") or {}
+                    declared = gate.get("declared") or 0
+                    overlap = {
+                        "declared": declared,
+                        "overlappable": gate.get("overlappable", 0),
+                        # capped at 1.0: EP measures MORE overlappable
+                        # wires than its (backward-hops-only) declaration
+                        "overlap_frac": (
+                            round(min(
+                                1.0, gate.get("overlappable", 0) / declared
+                            ), 4)
+                            if declared else None
+                        ),
+                        "gate_ok": gate.get("ok"),
+                        "clean": verdict["clean"],
+                    }
+                times, state, loss = time_windows(
+                    compiled, state, b, t, steps=steps, windows=3, warmup=2
+                )
+                del state
+                step_time = min(times) / steps
+                row = {
+                    "strategy": name,
+                    "comm_dtype": dtype,
+                    "grad_buckets": buckets,
+                    "step_time_s": round(step_time, 6),
+                    "tokens_per_sec_per_chip": round(
+                        batch * (seq - 1) / step_time / n_dev, 1
+                    ),
+                    "bytes_match": exact,
+                    "overlap": overlap,
+                    "involuntary_remat_warnings": cap["involuntary_remat"],
+                    "final_loss": round(loss, 6),
+                }
+                if dtype == "f32" and buckets == 0:
+                    f32_loss, f32_step = loss, step_time
+                else:
+                    row["loss_delta_vs_f32"] = (
+                        round(loss - f32_loss, 6)
+                        if f32_loss is not None else None
+                    )
+                    row["step_time_vs_f32"] = (
+                        round(step_time / f32_step, 4) if f32_step else None
+                    )
+                rows.append(row)
+            except Exception as exc:
+                rows.append({
+                    "strategy": name, "comm_dtype": dtype,
+                    "grad_buckets": buckets, "error": repr(exc),
+                })
+                print(
+                    f"comm overlap rung {name}/{dtype}/b{buckets} failed: "
+                    f"{exc!r}",
+                    file=sys.stderr,
+                )
+    return rows
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -1187,6 +1335,16 @@ def main(argv=None):
         quant_comm_rec = [{"strategy": "quant_comm", "error": repr(exc)}]
         print(f"quant comm ladder failed: {exc!r}", file=sys.stderr)
 
+    # Overlap-scheduled collectives (round 18, ROADMAP #5): f32 vs int8
+    # vs int8 + --grad_buckets 4 per strategy — step time, the promoted
+    # overlap-gate verdict (overlap_frac), per-bucket byte match.
+    comm_overlap_rec = None
+    try:
+        comm_overlap_rec = bench_comm_overlap(cfg, n_dev)
+    except Exception as exc:
+        comm_overlap_rec = [{"strategy": "comm_overlap", "error": repr(exc)}]
+        print(f"comm overlap ladder failed: {exc!r}", file=sys.stderr)
+
     # Elastic restore (round 13, ROADMAP #5): restore+reshard wall-clock,
     # bytes read, RSS high-water delta and the parity bit for a sharded
     # checkpoint landing on a half-size world.
@@ -1283,6 +1441,7 @@ def main(argv=None):
         "moe_ep_comm_error": moe_ep_comm_err,
         "moe_dispatch_ladder": moe_dispatch_ladder,
         "quant_comm": quant_comm_rec,
+        "comm_overlap": comm_overlap_rec,
         "elastic_restore": elastic_restore,
         "serving": serving_rec,
         "paged_kv": paged_kv_rec,

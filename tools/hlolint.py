@@ -5,10 +5,12 @@ Two modes:
 
   - `--world N` (the CI lane): compile the audited worlds on N virtual
     CPU devices — the dryrun's strategy set (DDP/FSDP f32+int8, the EP
-    a2a dispatch f32+int8) plus the serving decode steps (TP ring,
-    paged) — and run the full rule engine (tpukit/analysis/rules.py)
-    over each: CommPlan diff, involuntary-remat, s32-index-plumbing,
-    wire-upcast, donation-dropped, overlap. Any "error" finding exits 1.
+    a2a dispatch f32+int8, the round-18 overlapped DDP/FSDP/EP bucket
+    schedules) plus the serving decode steps (TP ring, paged) — and run
+    the full rule engine (tpukit/analysis/rules.py) over each: CommPlan
+    diff, involuntary-remat, s32-index-plumbing, wire-upcast,
+    donation-dropped, overlap (GATING on the *_overlap worlds — their
+    plans declare the bucket schedule). Any "error" finding exits 1.
   - `--hlo FILE [FILE...]`: lint saved HLO text (plain or .gz — the
     golden fixtures under tests/fixtures/hlo/). When a fixture's JSON
     sidecar sits next to the file, its recorded CommPlan and donation
@@ -64,17 +66,23 @@ def _ensure_env(n_devices: int) -> None:
 WORLDS = (
     "ddp_f32", "ddp_int8", "fsdp_f32", "fsdp_int8",
     "ep_a2a", "ep_int8", "tp_decode", "paged_decode", "spec_verify",
+    # round 18 (--grad_buckets): int8 + 4-bucket layer-reversed grad
+    # wire; the sidecar plan carries the overlap declaration so the
+    # promoted `overlap` rule gates the async/bucket schedule offline
+    "ddp_overlap", "fsdp_overlap", "ep_overlap",
 )
 
 # the golden-fixture subset checked into tests/fixtures/hlo/ (ISSUE 12);
-# ep_int8 compiles the most expensive world twice for little fixture value
+# ep_int8/ep_overlap compile the most expensive world again for little
+# fixture value
 FIXTURE_WORLDS = (
     "ddp_f32", "ddp_int8", "fsdp_f32", "fsdp_int8",
     "ep_a2a", "tp_decode", "paged_decode",
+    "ddp_overlap", "fsdp_overlap",
 )
 
 
-def _dryrun_cfg(comm_dtype="f32", num_experts=0):
+def _dryrun_cfg(comm_dtype="f32", num_experts=0, grad_buckets=0):
     import jax.numpy as jnp
 
     from tpukit.model import GPTConfig
@@ -83,6 +91,7 @@ def _dryrun_cfg(comm_dtype="f32", num_experts=0):
         dim=64, head_dim=16, heads=8, num_layers=4, vocab_size=128,
         max_position_embeddings=32, compute_dtype=jnp.float32,
         comm_dtype=comm_dtype, num_experts=num_experts,
+        grad_buckets=grad_buckets,
     )
 
 
@@ -99,18 +108,23 @@ def _train_world(name: str, n_devices: int) -> dict:
 
     devices = jax.devices()[:n_devices]
     inner = next((s for s in (4, 2) if n_devices % s == 0), 1)
+    # *_overlap worlds: the round-18 bucket schedule — int8 wire + 4
+    # layer-reversed grad buckets (EP: per-layer exchange, audit declared)
+    overlap = name.endswith("overlap")
+    comm = "f32" if name.endswith("f32") or name == "ep_a2a" else "int8"
     if name.startswith("ep"):
         if inner <= 1:
             raise SystemExit(f"world {name} needs a composite device count")
         cfg = _dryrun_cfg(
-            comm_dtype="int8" if name.endswith("int8") else "f32",
+            comm_dtype=comm,
             num_experts=2 * inner,
+            grad_buckets=4 if overlap else 0,
         )
         strategy = ExpertParallel(
             create_mesh({"data": n_devices // inner, "expert": inner}, devices)
         )
     else:
-        cfg = _dryrun_cfg(comm_dtype="int8" if name.endswith("int8") else "f32")
+        cfg = _dryrun_cfg(comm_dtype=comm, grad_buckets=4 if overlap else 0)
         cls = DataParallel if name.startswith("ddp") else FSDP
         strategy = cls(create_mesh({"data": n_devices}, devices))
 
@@ -293,6 +307,10 @@ def plan_from_meta(meta: dict):
     return CommPlan(
         label=meta.get("world", "fixture"), ops=p["ops"], wire=p["wire"],
         exhaustive=p["exhaustive"], comm_dtype=meta.get("comm_dtype", "f32"),
+        # round 18: the overlap declaration rides the sidecar so the
+        # promoted gate audits saved text like the live world (absent in
+        # pre-round-18 sidecars -> None -> reporting-only, as captured)
+        overlap=p.get("overlap"),
     )
 
 
@@ -327,6 +345,7 @@ def save_fixture(directory: Path, ctx: dict) -> None:
         "collectives": collective_summary(module),
         "plan": None if plan is None else {
             "ops": plan.ops, "wire": plan.wire, "exhaustive": plan.exhaustive,
+            "overlap": plan.overlap,
         },
         "remat_warnings": count_involuntary_remat(ctx["stderr"]),
         "jax_version": jax.__version__,

@@ -32,6 +32,19 @@ re-break in review because the broken form LOOKS idiomatic:
                      temperature/top-k/fold_in math into that one
                      function BECAUSE the triplication was the
                      token-parity guarantee's weak point.
+  collective-spelling The wire-collective launches (`lax.all_to_all`,
+                     `lax.all_gather`, `lax.psum_scatter` — each lowers
+                     to an async start/done pair on TPU) live in
+                     `tpukit/ops/quant_comm.py`, the bucket scheduler's
+                     home (round 18): a raw launch anywhere else
+                     bypasses the packed-payload/closed-form-byte/
+                     overlap-declaration machinery the audits gate, the
+                     way sampling math outside `_sample_next` bypassed
+                     the parity guarantee. ring_attention's ulysses
+                     head-repartition a2a + pad-mask gather carry
+                     reasoned waivers (activation re-layout inside the
+                     attention schedule, audited by CP's comm_ops — not
+                     a grad/dispatch wire).
 
 Waivers: a site that is legitimately outside a rule carries an inline
 comment on the flagged line —
@@ -67,12 +80,19 @@ SCAN_GLOBS = (
     "__graft_entry__.py",
 )
 
-RULES = ("atomic-publish", "retry-io", "sampling-spelling")
+RULES = ("atomic-publish", "retry-io", "sampling-spelling",
+         "collective-spelling")
 
 # The raw checkpoint I/O helpers that must ride retry_io.
 _RAW_IO_HELPERS = frozenset({
     "_read_blob", "_write_blob", "_write_shard", "_write_shard_digest",
 })
+
+# The wire-collective primitives quant_comm.py owns (collective-spelling):
+# the async-start spellings of the grad/dispatch wire. lax.psum/ppermute
+# stay unrestricted — scalar reductions and ring hops are not the bucket
+# scheduler's payload ops.
+_WIRE_COLLECTIVES = frozenset({"all_to_all", "all_gather", "psum_scatter"})
 
 _WAIVER_RE = re.compile(r"#\s*lint:\s*allow\(([\w\-]+)\)\s*:?\s*(.*)")
 
@@ -99,7 +119,8 @@ def _waiver_on(lines: list[str], lineno: int) -> tuple[str, str] | None:
 
 class _Visitor(ast.NodeVisitor):
     def __init__(self, path: Path, rel: str, lines: list[str],
-                 owner_funcs: frozenset[str]):
+                 owner_funcs: frozenset[str],
+                 wire_collective_owner: bool = False):
         self.path = path
         self.rel = rel
         self.lines = lines
@@ -107,6 +128,9 @@ class _Visitor(ast.NodeVisitor):
         # (the one-spelling owners); a same-named function in any other
         # file must not self-exempt
         self.owner_funcs = owner_funcs
+        # True only for tpukit/ops/quant_comm.py: the one file allowed to
+        # launch the wire collectives directly (collective-spelling)
+        self.wire_collective_owner = wire_collective_owner
         self.out: list[Violation] = []
         self.func_stack: list[str] = []
         # names bound by `from os import replace/rename` in this file
@@ -206,6 +230,22 @@ class _Visitor(ast.NodeVisitor):
                 "spelling (the round-14 parity guarantee); route through "
                 "_sample_next",
             )
+        # collective-spelling: a raw wire-collective launch (the async
+        # start/done ops of the grad/dispatch wire) outside quant_comm.py
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in _WIRE_COLLECTIVES
+            and not self.wire_collective_owner
+        ):
+            self._flag(
+                "collective-spelling", node,
+                f"lax.{fn.attr}() outside tpukit/ops/quant_comm.py — the "
+                f"wire collectives live in the bucket scheduler's home so "
+                f"every launch carries the packed payload, closed-form "
+                f"byte audit and overlap declaration (round 18); route "
+                f"through the quant_comm wrappers (or carry a waiver "
+                f"naming why this launch is not a grad/dispatch wire)",
+            )
         self.generic_visit(node)
 
 
@@ -230,7 +270,10 @@ def lint_file(path: Path, rel: str | None = None) -> list[Violation]:
         owners.update(_RAW_IO_HELPERS)  # a helper may recurse on itself
     if norm.endswith("tpukit/sampling.py"):
         owners.add("_sample_next")
-    v = _Visitor(path, rel, source.splitlines(), frozenset(owners))
+    v = _Visitor(
+        path, rel, source.splitlines(), frozenset(owners),
+        wire_collective_owner=norm.endswith("tpukit/ops/quant_comm.py"),
+    )
     v.visit(tree)
     return v.out
 

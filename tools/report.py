@@ -258,6 +258,11 @@ def summarize(records: list[dict]) -> str:
             if ov:
                 line += (f"   overlap: {ov.get('overlapped', 0)}/"
                          f"{ov.get('pairs', 0)} async pairs hide compute")
+            og = hl.get("overlap_gate")
+            if og:
+                line += (f"   overlap gate: {og.get('overlappable', 0)}/"
+                         f"{og.get('declared', 0)} bucket wires hidden"
+                         + (" OK" if og.get("ok") else " <- FAIL"))
             w(line)
 
     # standalone hlolint findings (tools/hlolint.py --out, or its JSONL
@@ -523,6 +528,40 @@ def summarize(records: list[dict]) -> str:
             cut = 1.0 / (sum(int8_ratios) / len(int8_ratios))
             w(f"  headline: int8 payloads move ~{cut:.1f}x fewer bytes on "
               f"the wire than f32 (mean over strategy rungs)")
+    # round-18 overlap schedule (ROADMAP #5): f32 vs int8 vs int8+buckets
+    # per strategy — the wire cut and the overlap win separately visible;
+    # overlap_frac is the gated schedule property (--min_overlap_frac),
+    # step time the wall-clock observable.
+    for r in records:
+        co = r.get("comm_overlap")
+        if not isinstance(co, list) or not co:
+            continue
+        w("== overlap-scheduled collectives (bench, --grad_buckets) ==")
+        for row in co:
+            if "error" in row:
+                w(f"  {row.get('strategy', '?'):<5} "
+                  f"{row.get('comm_dtype', '?'):<5} "
+                  f"b{row.get('grad_buckets', '?')} ERROR {row['error']}")
+                continue
+            label = (f"{row['comm_dtype']}"
+                     + (f"+overlap(b{row['grad_buckets']})"
+                        if row.get("grad_buckets") else ""))
+            ov = row.get("overlap") or {}
+            frac = ov.get("overlap_frac")
+            rel = row.get("step_time_vs_f32")
+            warns = row.get("involuntary_remat_warnings")
+            match = row.get("bytes_match")
+            w(f"  {row['strategy']:<5} {label:<16} "
+              f"step {row.get('step_time_s', 0) * 1e3:.2f}ms"
+              + (f" ({rel * 100:.1f}% of f32)" if rel is not None else "")
+              + f"   {human_count(row.get('tokens_per_sec_per_chip'))} tok/s/chip"
+              + (f"   overlap {ov.get('overlappable', '?')}/"
+                 f"{ov.get('declared', '?')} wires hidden"
+                 + (" OK" if ov.get("gate_ok") else " <- GATE FAIL")
+                 if frac is not None else "")
+              + ("" if match is None
+                 else ("   audit OK" if match else "   audit <- MISMATCH"))
+              + ("" if not warns else f"   remat warnings {warns}!"))
     # round-13 elastic restore (ROADMAP #5): what a reshard-on-restore
     # relaunch costs — wall-clock, bytes read, host RSS high-water delta,
     # and the byte-parity bit vs a direct restore. Rendered under the
@@ -703,6 +742,55 @@ def check_min_accept_rate(records: list[dict], threshold: float) -> tuple[bool, 
     )
 
 
+def check_min_overlap_frac(records: list[dict], threshold: float) -> tuple[bool, str]:
+    """Overlap-schedule gate (`--min_overlap_frac`, round 18): every
+    bucketed rung of the bench `comm_overlap` record must have
+    overlap_frac (overlappable / declared bucket wires, from the
+    promoted hlolint `overlap` rule) >= `threshold`. Returns
+    (ok, message) — a log without any overlap rung fails, so the gate
+    can't pass vacuously when someone drops the bucketed rungs from the
+    bench invocation. The fraction is the static schedule property: on
+    CPU virtual devices wall-clock overlap is noise, the structure is
+    what CI pins."""
+    fracs, broken = [], []
+    for r in records:
+        co = r.get("comm_overlap")
+        if not isinstance(co, list):
+            continue
+        for row in co:
+            if not isinstance(row, dict) or not row.get("grad_buckets"):
+                continue
+            # every BUCKETED rung must carry a verdict: an errored rung
+            # or one missing its overlap block is a gate failure, not a
+            # skipped sample — else a crashed strategy passes silently
+            name = f"{row.get('strategy', '?')}/b{row.get('grad_buckets')}"
+            ov = row.get("overlap")
+            if "error" in row or not isinstance(ov, dict) \
+                    or ov.get("overlap_frac") is None:
+                broken.append(name)
+                continue
+            if ov.get("gate_ok") is False:
+                broken.append(name + " (gate FAIL)")
+            fracs.append((name, ov["overlap_frac"]))
+    if not fracs and not broken:
+        return False, ("--min_overlap_frac: no comm_overlap rung with an "
+                       "overlap verdict in the log (did the bench run the "
+                       "--grad_buckets rungs?)")
+    if broken:
+        return False, (
+            f"--min_overlap_frac FAIL: bucketed rung(s) without a passing "
+            f"overlap verdict: {', '.join(broken)}"
+        )
+    worst_name, worst = min(fracs, key=lambda sf: sf[1])
+    ok = worst >= threshold
+    verdict = "OK" if ok else "FAIL"
+    return ok, (
+        f"--min_overlap_frac {verdict}: min overlap_frac {worst:.3f} "
+        f"({worst_name}) over {len(fracs)} bucketed rungs "
+        f"(threshold {threshold:.3f})"
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("log", help="metrics JSONL written via --metrics_log")
@@ -722,6 +810,13 @@ def main(argv=None) -> int:
         "rate >= FRACTION (exit 2 below it, or when the log has no spec "
         "summary) — the draft-health regression gate for CI",
     )
+    ap.add_argument(
+        "--min_overlap_frac", type=float, default=None, metavar="FRACTION",
+        help="assert every bucketed comm_overlap bench rung's "
+        "overlap_frac (hlolint-measured hidden-wires fraction) >= "
+        "FRACTION (exit 2 below it, or when the log has no overlap "
+        "rung) — the overlap-schedule regression gate for CI",
+    )
     args = ap.parse_args(argv)
     records = load(args.log)
     if not records:
@@ -739,6 +834,10 @@ def main(argv=None) -> int:
         rc = rc if ok else 2
     if args.min_accept_rate is not None:
         ok, msg = check_min_accept_rate(records, args.min_accept_rate)
+        print(msg, file=sys.stdout if ok else sys.stderr)
+        rc = rc if ok else 2
+    if args.min_overlap_frac is not None:
+        ok, msg = check_min_overlap_frac(records, args.min_overlap_frac)
         print(msg, file=sys.stdout if ok else sys.stderr)
         rc = rc if ok else 2
     return rc

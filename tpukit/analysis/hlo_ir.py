@@ -256,6 +256,72 @@ class HloModule:
         """Parameter numbers covered by at least one alias entry."""
         return {a.param_number for a in self.aliases}
 
+    def concurrent_compute(self, instr: Instruction) -> int:
+        """How many compute instructions in `instr`'s computation are
+        INDEPENDENT of it — neither in its operand (ancestor) cone nor in
+        its result (descendant) cone. This is the dataflow form of the
+        overlap question: independent work is exactly what a scheduler
+        (XLA's latency-hiding scheduler on TPU, the thunk executor's
+        concurrency on CPU) may place between a collective's start and
+        done. An async `-start`/`-done` pair's compute_between is a
+        schedule SAMPLE of this set; the cone measure is the
+        backend-independent upper structure — a collective with an empty
+        independent set can never overlap anything, whatever the
+        scheduler does. Non-compute shuffles (_NONCOMPUTE_OPS), other
+        collectives and `-done` halves don't count: hiding a wire behind
+        another wire is not overlap."""
+        comp = self.computations.get(instr.computation)
+        if comp is None:
+            return 0
+        by_name, users = self._adjacency(comp)
+
+        def cone(start: str, edges) -> set[str]:
+            seen, todo = set(), [start]
+            while todo:
+                name = todo.pop()
+                if name in seen:
+                    continue
+                seen.add(name)
+                todo.extend(edges(name))
+            return seen
+
+        ancestors = cone(
+            instr.name,
+            lambda n: (op for op in by_name[n].operands if op in by_name),
+        )
+        descendants = cone(instr.name, lambda n: users.get(n, ()))
+        dependent = ancestors | descendants
+        count = 0
+        for i in comp.instructions:
+            if i.name in dependent:
+                continue
+            if i.opcode in _NONCOMPUTE_OPS or i.is_done:
+                continue
+            if i.base_op in COLLECTIVE_OPS:
+                continue
+            count += 1
+        return count
+
+    def _adjacency(self, comp: Computation):
+        """(by_name, users) maps for one computation, memoized — the
+        overlap gate walks one cone pair per declared collective, and
+        rebuilding the maps per walk is O(collectives x instructions)
+        for nothing."""
+        cache = getattr(self, "_adjacency_cache", None)
+        if cache is None:
+            cache = self._adjacency_cache = {}
+        hit = cache.get(comp.name)
+        if hit is not None:
+            return hit
+        by_name = {i.name: i for i in comp.instructions}
+        users: dict[str, list[str]] = {}
+        for i in comp.instructions:
+            for op in i.operands:
+                if op in by_name:
+                    users.setdefault(op, []).append(i.name)
+        cache[comp.name] = (by_name, users)
+        return cache[comp.name]
+
 
 # -- parsing ----------------------------------------------------------------
 

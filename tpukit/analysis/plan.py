@@ -47,6 +47,13 @@ class CommPlan:
     exhaustive: bool = False
     # nominal comm dtype the run declared (--comm_dtype), for reporting
     comm_dtype: str = "f32"
+    # Overlap declaration (round 18, --grad_buckets): {op: K} — at least
+    # K collectives of that kind must each have independent compute the
+    # scheduler can hide them behind (Strategy.overlap_comm). None = the
+    # serial schedule; the hlolint `overlap` rule stays reporting-only.
+    # With a declaration the rule GATES (severity error on shortfall) —
+    # a world that claims bucketed overlap must show the structure.
+    overlap: dict | None = None
 
     def expected(self, op: str) -> dict:
         return self.ops.get(op, {"count": 0, "bytes": 0})
@@ -120,9 +127,18 @@ def train_comm_plan(strategy, cfg, *, param_shapes=None, global_batch=None,
 
     if not ops:
         return None
+    # --grad_buckets overlap declaration (train phase only — eval has no
+    # backward, hence no grad wire to overlap): the strategy names how
+    # many of each op kind must be independently schedulable; the rule
+    # engine's `overlap` gate measures the compiled module against it.
+    overlap = None
+    overlap_fn = getattr(strategy, "overlap_comm", None)
+    if phase == "train" and overlap_fn is not None:
+        overlap = overlap_fn(cfg, param_shapes)
     return CommPlan(
         label=f"{strategy.name} {phase} step",
         ops=ops, wire=wire, exhaustive=False, comm_dtype=comm,
+        overlap=overlap,
     )
 
 

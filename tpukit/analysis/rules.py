@@ -44,10 +44,27 @@ Rule catalog (and where each one came from):
                      from the persistent compile cache mis-alias donated
                      buffers (serve/decode.py strips donation for exactly
                      that reason).
-  overlap            For each async `-start`/`-done` pair, does compute
-                     actually sit between them? Reporting-only today
-                     (severity "info"); becomes the ROADMAP #5 gate when
-                     the bucketed grad exchange lands.
+  overlap            Two halves (ROADMAP #5, promoted round 18). The
+                     reporting half: for each async `-start`/`-done`
+                     pair, does compute actually sit between them
+                     (severity "info", unchanged since round 16). The
+                     GATE half: when the CommPlan DECLARES an overlap
+                     schedule (plan.overlap = {op: K}, set by
+                     --grad_buckets worlds via Strategy.overlap_comm),
+                     at least K collectives of each declared kind must
+                     each have >= OVERLAP_MIN_CONCURRENT compute
+                     instructions INDEPENDENT of them in the dataflow
+                     (HloModule.concurrent_compute — neither ancestor
+                     nor descendant, so a scheduler may run them between
+                     the wire's start and done on any backend, async
+                     pairs or not). Shortfall is an error: a world that
+                     claims bucketed overlap must show the structure.
+                     On declared worlds an async pair of a declared op
+                     with NOTHING between start and done also errors —
+                     but only when its own cone shows overlap was
+                     AVAILABLE (the async form was bought and wasted);
+                     a dataflow-serial pair of the same op kind (EP's
+                     forward dispatch hops) stays info.
 """
 
 from __future__ import annotations
@@ -67,6 +84,18 @@ INVOLUNTARY_REMAT = "Involuntary full rematerialization"
 # Integer collective payloads smaller than this are scalar bookkeeping
 # (token counts, loop carries), not index plumbing.
 S32_PLUMBING_MIN_BYTES = 256
+
+# A declared-overlap collective counts as overlappable when at least this
+# many compute instructions are independent of it (concurrent_compute).
+# Post-fusion a "compute instruction" is typically a whole fused kernel.
+# Calibrated on the audited worlds: a SERIAL schedule's one flattened
+# payload shows 7-9 independent fusions (the rng/token-count/loss-scalar
+# residue — roughly constant across model shapes), while the smallest
+# genuine bucket/backward wire measured 41+ and GROWS with the model
+# (every other bucket's backward is independent of it). 16 sits between
+# with margin both ways; the gate's job is to catch serial schedules
+# claiming overlap, not to grade schedulers.
+OVERLAP_MIN_CONCURRENT = 16
 
 SEVERITIES = ("error", "warn", "info")
 
@@ -269,17 +298,38 @@ def _rule_donation_dropped(module: HloModule, ctx: dict) -> list[Finding]:
 
 
 def _rule_overlap(module: HloModule, ctx: dict) -> list[Finding]:
+    plan: CommPlan | None = ctx.get("plan")
+    declared = getattr(plan, "overlap", None) if plan is not None else None
     out = []
     for pair in module.async_pairs():
+        # On an overlap-declared world, an empty declared-op pair is a
+        # regression ONLY when the pair provably COULD have overlapped:
+        # its independent-compute cone clears the bar yet the schedule
+        # placed nothing between start and done (the async form was
+        # bought for exactly this wire and wasted). A pair whose cone is
+        # empty-ish stays info — EP's forward dispatch hops are honestly
+        # serial by dataflow and share the declared op KIND with the
+        # backward hops the declaration actually covers; erroring on
+        # them would fail worlds for a schedule they never promised.
+        gate = bool(declared) and pair.start.base_op in (declared or {})
+        could_overlap = (
+            gate and not pair.overlapped
+            and module.concurrent_compute(pair.start)
+            >= OVERLAP_MIN_CONCURRENT
+        )
+        severity = "error" if could_overlap else "info"
         out.append(Finding(
-            rule="overlap", severity="info",
+            rule="overlap", severity=severity,
             message=(
                 f"{pair.start.opcode} %{pair.start.name}: "
                 f"{pair.compute_between} compute op(s) between start and "
                 f"done — "
                 + ("overlapped" if pair.overlapped
                    else "NO overlap (the pair completes back-to-back; "
-                        "the async form bought nothing)")
+                        "the async form bought nothing"
+                        + (", with independent compute AVAILABLE on a "
+                           "world that DECLARED bucketed overlap)"
+                           if could_overlap else ")"))
             ),
             computation=pair.start.computation,
             instruction=pair.start.name,
@@ -288,6 +338,49 @@ def _rule_overlap(module: HloModule, ctx: dict) -> list[Finding]:
                   "between": len(pair.between),
                   "overlapped": pair.overlapped},
         ))
+    if not declared:
+        return out
+    # The gate half: the declared bucket wires must be independently
+    # schedulable. Measured in the dataflow (concurrent_compute), so the
+    # verdict is identical whether the backend prints async pairs (TPU)
+    # or sync collectives (XLA:CPU) — a serial one-payload-after-backward
+    # schedule fails it on both.
+    for op, need in sorted(declared.items()):
+        colls = [i for i in module.collectives() if i.base_op == op]
+        conc = {i.name: module.concurrent_compute(i) for i in colls}
+        hidden = [n for n, c in conc.items() if c >= OVERLAP_MIN_CONCURRENT]
+        occupancy = sorted(conc.values())
+        data = {
+            "op": op, "declared": int(need), "measured": len(colls),
+            "overlappable": len(hidden),
+            "min_concurrent": occupancy[0] if occupancy else 0,
+            "max_concurrent": occupancy[-1] if occupancy else 0,
+            "threshold": OVERLAP_MIN_CONCURRENT,
+        }
+        if len(hidden) < int(need):
+            out.append(Finding(
+                rule="overlap", severity="error",
+                message=(
+                    f"{plan.label}: declared {need} overlap-scheduled {op} "
+                    f"bucket wire(s), only {len(hidden)} of {len(colls)} "
+                    f"have >= {OVERLAP_MIN_CONCURRENT} independent compute "
+                    f"op(s) to hide behind (per-op concurrency "
+                    f"{occupancy}) — the schedule is serial where it "
+                    f"claims to overlap"
+                ),
+                data=data,
+            ))
+        else:
+            out.append(Finding(
+                rule="overlap", severity="info",
+                message=(
+                    f"{plan.label}: overlap gate ok — {len(hidden)}/"
+                    f"{len(colls)} {op} wire(s) independently schedulable "
+                    f"(declared {need}, min concurrent compute "
+                    f"{data['min_concurrent']})"
+                ),
+                data=data,
+            ))
     return out
 
 
@@ -345,7 +438,13 @@ def summarize(findings: list[Finding]) -> dict:
     error/warn counts, the violated rule names, and the overlap tally."""
     errors = [f for f in findings if f.severity == "error"]
     warns = [f for f in findings if f.severity == "warn"]
-    pairs = [f for f in findings if f.rule == "overlap"]
+    # async-pair reports carry compute_between; the round-18 gate verdicts
+    # carry a declared count instead — summarized separately so a record
+    # reader can tell "pairs seen" from "gate measured"
+    pairs = [f for f in findings
+             if f.rule == "overlap" and "compute_between" in f.data]
+    gates = [f for f in findings
+             if f.rule == "overlap" and "declared" in f.data]
     out = {
         "clean": not errors,
         "errors": len(errors),
@@ -360,6 +459,12 @@ def summarize(findings: list[Finding]) -> dict:
             "overlapped": sum(
                 1 for f in pairs if f.data.get("overlapped")
             ),
+        }
+    if gates:
+        out["overlap_gate"] = {
+            "declared": sum(f.data["declared"] for f in gates),
+            "overlappable": sum(f.data["overlappable"] for f in gates),
+            "ok": all(f.severity != "error" for f in gates),
         }
     return out
 

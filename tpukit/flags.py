@@ -199,6 +199,16 @@ class TrainFlags:
     # Stochastic rounding for the int8 quantizer (unbiased per element;
     # default off = round-to-nearest-even).
     quant_stochastic: bool = False
+    # Overlap-scheduled gradient collectives (round 18, ROADMAP #5):
+    # 0 (default) = the serial schedule, byte-identical HLO. N >= 1 =
+    # DDP/FSDP partition the grad tree into N ~equal-byte buckets in
+    # layer-reversed order and launch each bucket's collective as soon as
+    # its grads are ready, overlapping wire with the remaining backward;
+    # under ExpertParallel (per-layer a2a, already bucket-granular) any
+    # N declares the hlolint `overlap` gate. Composes with --comm_dtype
+    # (int8 wire cut + overlap win stack). Strategies without a
+    # hand-placed grad wire reject the flag at startup.
+    grad_buckets: int = 0
 
 
 # The canonical 12 flags of every reference recipe (main-single.py:156-167).
@@ -299,6 +309,9 @@ def build_parser(
         default=defaults.comm_dtype,
     )
     parser.add_argument("--quant_stochastic", action="store_true")
+    parser.add_argument(
+        "--grad_buckets", type=int, default=defaults.grad_buckets
+    )
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--scan_layers", action="store_true")
     parser.add_argument("--microbatches", type=int, default=defaults.microbatches)

@@ -170,12 +170,32 @@ class GPTConfig:
     # unbiased per element, the EQuARX option against long-horizon rounding
     # drift). Default OFF — round-to-nearest-even.
     quant_stochastic: bool = False
+    # Overlap-scheduled gradient collectives (round 18, ROADMAP #5 —
+    # tpukit/ops/quant_comm.py bucket scheduler). 0 (default): the serial
+    # schedule — one flattened payload after backward completes,
+    # byte-identical HLO to round 17. N >= 1: DataParallel/FSDP partition
+    # the grad tree into N ~equal-byte buckets in layer-reversed
+    # (backward-completion) order and issue each bucket's collective the
+    # moment its grads exist, so the remaining backward compute hides the
+    # wire (1 = the serial schedule expressed in the bucket machinery —
+    # the bit-parity reference for the f32 tests). Composes with
+    # --comm_dtype: the int8 wire cut and the overlap win stack. Under
+    # ExpertParallel the a2a exchange is already per-layer, so any
+    # N >= 1 declares the hlolint `overlap` gate without changing the
+    # dataflow. Strategies without a hand-placed grad wire reject N > 0
+    # at validate_config.
+    grad_buckets: int = 0
 
     def __post_init__(self):
         if self.comm_dtype not in ("f32", "bf16", "int8"):
             raise ValueError(
                 f"comm_dtype={self.comm_dtype!r} must be 'f32', 'bf16' or "
                 f"'int8'"
+            )
+        if self.grad_buckets < 0:
+            raise ValueError(
+                f"grad_buckets={self.grad_buckets} must be >= 0 (0 = the "
+                f"serial schedule, N = bucket count)"
             )
         if self.num_experts > 0 and not (1 <= self.router_top_k <= self.num_experts):
             raise ValueError(

@@ -51,6 +51,32 @@ static Python ints — `lax.axis_size` is not static on every supported jax):
     layers train, 6 remat, 2 eval) is byte-for-byte the audit the f32
     path already proves.
 
+Bucket scheduler (`--grad_buckets`, round 18 — ROADMAP #5): the serial
+payloads above fire AFTER backward completes, so wire time adds directly
+to step time. The bucket spellings below partition the grad tree into
+~equal-byte buckets in layer-reversed (backward-completion) order and
+issue one exchange per bucket the moment that bucket's grads exist in
+the dataflow — each bucket's collective depends only on its own leaves'
+backward, so the remaining backward compute is schedulable between the
+collective's start and done (XLA's latency-hiding scheduler on TPU; the
+hlolint `overlap` rule audits the independence structurally on every
+backend). This module is the ONE home of that machinery
+(tools/lint_invariants.py's collective-spelling rule keeps it so):
+
+  - `grad_bucket_plan`: the deterministic partition (leaf indices per
+    bucket) shared by the value_and_grad blocks AND the closed-form
+    byte audits — predicting bucket bytes requires agreeing on buckets.
+  - `bucket_all_reduce` / `bucketed_psum_tree`: the DDP bucket wire —
+    the EQuARX two-shot per bucket at EVERY comm dtype (f32 keeps the
+    two-shot shape rather than lax.psum, so the f32 bucket schedule is
+    the same auditable a2a+all_gather pair and bit-identical across
+    bucket counts: element sums run in fixed device order).
+  - `bucket_gather_qgrad`: the FSDP bucket wire — per-leaf FULL
+    PRECISION forward gathers (unchanged vs the serial path), ONE
+    packed reduce-scatter a2a per bucket in the backward (the serial
+    path pays one a2a per leaf; bucketing amortizes per-op latency and
+    creates the independent payloads overlap needs).
+
 `comm_dtype` modes: "f32" = passthrough (the exact pre-round-12 HLO);
 "bf16" = payload cast to bf16, f32 accumulation, no sidecar; "int8" =
 block-scaled payload + packed scale sidecar. Because quantization is lossy
@@ -71,6 +97,8 @@ on every backend.
 from __future__ import annotations
 
 from functools import partial
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -481,6 +509,236 @@ def exchange_all_to_all(x, axis_name: str, world: int, mode: str,
     return _quant_a2a(x, axis_name, world, mode, block, stochastic)
 
 
+# -- bucket scheduler (--grad_buckets, round 18) ----------------------------
+
+
+def _backward_rank(path) -> tuple[int, int]:
+    """Backward-completion rank of one param-tree path: lower = its grads
+    exist EARLIER in the backward sweep (head -> norm_out -> layers in
+    reverse index order -> embeddings). Buckets are contiguous runs of
+    this order, so bucket 0's collective can launch while the rest of the
+    backward still runs. A stacked (scan_layers) layer leaf has no
+    per-layer index and rides as one run."""
+    names, layer_idx = [], 0
+    for k in path:
+        if isinstance(k, jax.tree_util.DictKey):
+            names.append(k.key)
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            layer_idx = k.idx
+    if "lm_head" in names:
+        return (0, 0)
+    if "norm_out" in names:
+        return (0, 1)
+    if "layers" in names:
+        return (1, -layer_idx)  # layer L-1's backward completes first
+    return (2, 0)  # embeddings: the very last grads of the sweep
+
+
+def grad_bucket_plan(tree, n_buckets: int, include=None) -> list[list[int]]:
+    """Partition `tree`'s flat leaf indices into <= n_buckets contiguous
+    buckets of ~equal bytes, ordered by backward completion (layer-
+    reversed). The ONE partition spelling: the value_and_grad bucket
+    blocks and the closed-form byte audits (`expected_bucketed_*`,
+    `Strategy.grad_comm`) must agree on it, or the audit predicts a
+    schedule the program does not run. `include` (a set of flat indices)
+    restricts the partition — FSDP buckets only its SHARDED leaves;
+    replicated sub-threshold leaves stay on the f32 psum path."""
+    if n_buckets < 1:
+        raise ValueError(f"n_buckets must be >= 1, got {n_buckets}")
+    paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    items = [
+        (i, _backward_rank(path), _tree_leaf_size(leaf))
+        for i, (path, leaf) in enumerate(paths)
+        if include is None or i in include
+    ]
+    if not items:
+        return []
+    items.sort(key=lambda it: it[1])  # stable: ties keep tree order
+    total = sum(size for _, _, size in items) or 1
+    n_b = min(n_buckets, len(items))
+    buckets: list[list[int]] = [[]]
+    acc = 0
+    for pos, (i, _, size) in enumerate(items):
+        b = len(buckets) - 1
+        if (
+            buckets[b]
+            and b < n_b - 1
+            and (
+                acc >= total * (b + 1) / n_b
+                or len(items) - pos == n_b - 1 - b
+            )
+        ):
+            buckets.append([])
+        buckets[-1].append(i)
+        acc += size
+    return buckets
+
+
+def _tree_leaf_size(leaf) -> int:
+    """BYTES of one leaf (the partition's balance unit — the contract is
+    ~equal wire bytes, and a mixed-dtype tree balanced by element count
+    would skew buckets by the itemsize ratio). Leaves without a dtype
+    (plain shapes) price as f32."""
+    n = 1
+    for d in leaf.shape:
+        n *= int(d)
+    dtype = getattr(leaf, "dtype", None)
+    if dtype is None:
+        return n * 4
+    try:
+        return n * int(np.dtype(dtype).itemsize)
+    except TypeError:  # exotic/opaque dtypes (e.g. PRNG keys): price as f32
+        return n * 4
+
+
+def bucket_all_reduce(x, axis_name: str, world: int, dtype: str = "f32",
+                      block: int = DEFAULT_BLOCK, rng=None):
+    """Sum one flat bucket payload over `axis_name` as the two-shot
+    exchange at EVERY dtype — unlike quantized_all_reduce, "f32" keeps
+    the a2a + all_gather shape (f32 rows, no packing) instead of
+    lax.psum: the f32 bucket schedule is then the same pair of auditable,
+    mutually-independent collectives the quantized one is, and the
+    reduced value of every element is a fixed-device-order f32 sum —
+    bit-identical under ANY bucket partition (the f32 parity bar)."""
+    _check_dtype(dtype)
+    shape, n = x.shape, x.size
+    chunk = _ceil_to(max(n, 1), world) // world
+    total = world * chunk
+    flat = jnp.pad(x.reshape(-1).astype(jnp.float32), (0, total - n))
+    parts = flat.reshape(world, chunk)
+    if dtype == "int8":
+        r1 = r2 = None
+        if rng is not None:
+            r1, r2 = jax.random.split(rng)
+        packed = pack_quantized(parts, block, r1)
+        if world > 1:
+            packed = jax.lax.all_to_all(packed, axis_name, 0, 0, tiled=True)
+        red = jnp.sum(unpack_dequantized(packed, chunk, block), axis=0)
+        row = pack_quantized(red[None], block, r2)[0]
+        if world > 1:
+            gathered = jax.lax.all_gather(row, axis_name, axis=0, tiled=False)
+        else:
+            gathered = row[None]
+        res = unpack_dequantized(gathered, chunk, block).reshape(total)
+    else:
+        payload = parts if dtype == "f32" else parts.astype(jnp.bfloat16)
+        if world > 1:
+            payload = jax.lax.all_to_all(payload, axis_name, 0, 0, tiled=True)
+        red = jnp.sum(payload.astype(jnp.float32), axis=0)  # f32 accumulate
+        out = red if dtype == "f32" else red.astype(jnp.bfloat16)
+        if world > 1:
+            gathered = jax.lax.all_gather(out, axis_name, axis=0, tiled=False)
+        else:
+            gathered = out[None]
+        res = gathered.astype(jnp.float32).reshape(total)
+    return res[:n].reshape(shape).astype(x.dtype)
+
+
+def bucketed_psum_tree(tree, axis_name: str, world: int, n_buckets: int,
+                       dtype: str = "f32", block: int = DEFAULT_BLOCK,
+                       rng=None):
+    """The DDP bucket grad wire: partition `tree`'s leaves via
+    grad_bucket_plan and run one bucket_all_reduce per bucket. Each
+    bucket's exchange depends only on its own leaves' backward, so the
+    collectives are mutually independent — the overlap the serial
+    quantized_psum_tree (one payload after the whole backward) cannot
+    express. Stochastic-rounding keys fold per bucket index so buckets
+    never share rounding noise."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    buckets = grad_bucket_plan(tree, n_buckets)
+    out = list(leaves)
+    for b, idxs in enumerate(buckets):
+        flat = jnp.concatenate(
+            [leaves[i].reshape(-1).astype(jnp.float32) for i in idxs]
+        )
+        b_rng = jax.random.fold_in(rng, b) if rng is not None else None
+        red = bucket_all_reduce(flat, axis_name, world, dtype, block, b_rng)
+        off = 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = red[off:off + n].reshape(leaves[i].shape).astype(
+                leaves[i].dtype
+            )
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _bucket_scatter_grads(g, axis_name: str, world: int, dims, dtype: str,
+                          block: int, stochastic: bool):
+    """Backward half of bucket_gather_qgrad: concatenate the bucket's
+    cotangents (each the FULL gathered-shape grad) into one [world, n_c]
+    payload, move it through ONE reduce-scatter-shaped all_to_all
+    (packed at int8, raw rows at f32/bf16, f32 accumulation always), and
+    split each leaf's shard back out. The per-element sum runs in fixed
+    device order, so the f32 result is bit-identical under any bucket
+    partition."""
+    parts, metas = [], []
+    for gi, dim in zip(g, dims):
+        moved = jnp.moveaxis(gi, dim, 0)
+        shard_shape = (moved.shape[0] // world,) + moved.shape[1:]
+        parts.append(moved.astype(jnp.float32).reshape(world, -1))
+        metas.append((shard_shape, dim))
+    row = jnp.concatenate(parts, axis=1)  # [world, n_c]
+    n_c = row.shape[1]
+    if dtype == "int8":
+        rng = _fallback_key(axis_name, row) if stochastic else None
+        packed = pack_quantized(row, block, rng)
+        if world > 1:
+            packed = jax.lax.all_to_all(packed, axis_name, 0, 0, tiled=True)
+        red = jnp.sum(unpack_dequantized(packed, n_c, block), axis=0)
+    else:
+        payload = row if dtype == "f32" else row.astype(jnp.bfloat16)
+        if world > 1:
+            payload = jax.lax.all_to_all(payload, axis_name, 0, 0, tiled=True)
+        red = jnp.sum(payload.astype(jnp.float32), axis=0)
+    out, off = [], 0
+    for gi, (shard_shape, dim) in zip(g, metas):
+        n = 1
+        for d in shard_shape:
+            n *= d
+        seg = red[off:off + n].reshape(shard_shape)
+        out.append(jnp.moveaxis(seg, 0, dim).astype(gi.dtype))
+        off += n
+    return tuple(out)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+def bucket_gather_qgrad(xs, axis_name: str, world: int, dims, dtype: str,
+                        block: int, stochastic: bool):
+    """FSDP gather-at-use for one BUCKET of sharded leaves: forward is
+    the per-leaf FULL-PRECISION lax.all_gather (identical ops and bytes
+    to the serial all_gather_qgrad path — params at use stay exact);
+    backward compresses the bucket's cotangents through ONE packed
+    reduce-scatter a2a instead of one per leaf. The vjp node consumes
+    every leaf's cotangent at once, which in the backward sweep is the
+    moment the bucket's LAST (earliest-layer) grad lands — exactly the
+    "launch when the bucket's grads are ready" schedule. `dims` is the
+    per-leaf sharded dimension (static)."""
+    if world <= 1:
+        return tuple(xs)
+    return tuple(
+        jax.lax.all_gather(x, axis_name, axis=d, tiled=True)
+        for x, d in zip(xs, dims)
+    )
+
+
+def _bgq_fwd(xs, axis_name, world, dims, dtype, block, stochastic):
+    return bucket_gather_qgrad(
+        xs, axis_name, world, dims, dtype, block, stochastic
+    ), None
+
+
+def _bgq_bwd(axis_name, world, dims, dtype, block, stochastic, _, g):
+    if world <= 1:
+        return (tuple(g),)
+    return (_bucket_scatter_grads(
+        g, axis_name, world, dims, dtype, block, stochastic
+    ),)
+
+
+bucket_gather_qgrad.defvjp(_bgq_fwd, _bgq_bwd)
+
+
 # -- closed-form expected bytes (the audit half) ----------------------------
 
 
@@ -518,3 +776,55 @@ def expected_reduce_scatter(n: int, world: int, dtype: str,
     else:
         row = n_c * wire_itemsize("bf16", backend)
     return {"all-to-all": {"count": 1, "bytes": world * row}}
+
+
+def _bucket_row_bytes(n_c: int, dtype: str, block: int,
+                      backend: str | None) -> int:
+    """Wire bytes of one per-destination row covering n_c f32 elements at
+    the bucket payload dtype (f32 rows travel raw — the f32 bucket
+    schedule keeps the two-shot shape)."""
+    if dtype == "int8":
+        return packed_bytes(n_c, block)
+    if dtype == "bf16":
+        return n_c * wire_itemsize("bf16", backend)
+    return n_c * 4
+
+
+def expected_bucketed_all_reduce(sizes, world: int, dtype: str,
+                                 block: int = DEFAULT_BLOCK,
+                                 backend: str | None = None) -> dict | None:
+    """Expected per-device HLO result payload of the DDP bucket wire:
+    `sizes` = element count per bucket (from grad_bucket_plan) — one
+    two-shot exchange each, so len(sizes) all_to_alls + all_gathers of
+    [world, row]. Unlike expected_all_reduce this prices f32 too: the
+    bucket schedule keeps the two-shot shape at every dtype."""
+    sizes = [s for s in sizes if s > 0]
+    if not sizes or world <= 1:
+        return None
+    a2a = ag = 0
+    for n in sizes:
+        chunk = _ceil_to(max(n, 1), world) // world
+        row = _bucket_row_bytes(chunk, dtype, block, backend)
+        a2a += world * row
+        ag += world * row
+    return {
+        "all-to-all": {"count": len(sizes), "bytes": a2a},
+        "all-gather": {"count": len(sizes), "bytes": ag},
+    }
+
+
+def expected_bucketed_reduce_scatter(sizes, world: int, dtype: str,
+                                     block: int = DEFAULT_BLOCK,
+                                     backend: str | None = None) -> dict | None:
+    """Expected result payload of the FSDP bucket grad wire: `sizes` =
+    TOTAL element count per bucket (sum of the bucket's leaf sizes, every
+    leaf's sharded dim dividing `world`) — one packed reduce-scatter
+    all_to_all of [world, row] per bucket."""
+    sizes = [s for s in sizes if s > 0]
+    if not sizes or world <= 1:
+        return None
+    total = 0
+    for n in sizes:
+        n_c = n // world
+        total += world * _bucket_row_bytes(n_c, dtype, block, backend)
+    return {"all-to-all": {"count": len(sizes), "bytes": total}}

@@ -110,16 +110,16 @@ def ulysses_attention(
     # One stacked exchange for q/k/v (axes shift by one under the stack):
     # a single all_to_all instead of three dependency-free launches.
     qkv = jnp.stack([q, k, v])  # [3, B, h, S/P, d]
-    qkv = jax.lax.all_to_all(qkv, axis_name, split_axis=2, concat_axis=3, tiled=True)
+    qkv = jax.lax.all_to_all(qkv, axis_name, split_axis=2, concat_axis=3, tiled=True)  # lint: allow(collective-spelling): ulysses head re-partition — activation re-layout inside the attention schedule (CP comm_ops audits it), not a grad/dispatch wire
     qh, kh, vh = qkv[0], qkv[1], qkv[2]  # [B, h/P, S, d] each
     if pad_mask is not None:
-        pad_mask = jax.lax.all_gather(pad_mask, axis_name, axis=1, tiled=True)
+        pad_mask = jax.lax.all_gather(pad_mask, axis_name, axis=1, tiled=True)  # lint: allow(collective-spelling): boolean pad-mask broadcast for the gathered sequence — bytes are negligible and audited by CP comm_ops, not a payload wire
 
     from tpukit.ops.attention import causal_attention
 
     out = causal_attention(qh, kh, vh, scale=scale, pad_mask=pad_mask, impl="auto")
     # heads -> seq: the inverse exchange
-    return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1, tiled=True)  # lint: allow(collective-spelling): ulysses inverse head re-partition — same activation re-layout as the forward exchange
 
 
 def _online_update(m, l, acc, s, v_blk):

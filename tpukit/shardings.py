@@ -114,7 +114,15 @@ def _quantized_dp_grads(strategy, params, cfg, batch, targets, rng):
     lossy step is the wire — quant_comm.quantized_psum_tree flattens the
     grad tree into one payload and runs the EQuARX two-shot all-reduce
     (int8 reduce-scatter -> f32 accumulate -> int8 all-gather). The loss
-    scalar and the global valid-token count psum in full precision."""
+    scalar and the global valid-token count psum in full precision.
+
+    --grad_buckets N >= 1 (round 18) replaces the single payload with
+    quant_comm.bucketed_psum_tree: N ~equal-byte buckets in layer-
+    reversed order, one two-shot exchange each (f32 keeps the two-shot
+    shape — the bucket collectives stay auditable and the f32 trajectory
+    is bit-identical under any bucket count). Each bucket's exchange
+    depends only on its own leaves' backward, so the remaining backward
+    compute overlaps the wire — the hlolint `overlap` rule gates it."""
     from tpukit.compat import shard_map
 
     mesh = strategy.mesh
@@ -140,10 +148,16 @@ def _quantized_dp_grads(strategy, params, cfg, batch, targets, rng):
 
         val, grads = jax.value_and_grad(local_loss)(p)
         loss = jax.lax.psum(val, "data")
-        grads = quant_comm.quantized_psum_tree(
-            grads, "data", world, cfg.comm_dtype,
-            rng=_quant_rng(cfg, local_rng),
-        )
+        if cfg.grad_buckets > 0:
+            grads = quant_comm.bucketed_psum_tree(
+                grads, "data", world, cfg.grad_buckets, cfg.comm_dtype,
+                rng=_quant_rng(cfg, local_rng),
+            )
+        else:
+            grads = quant_comm.quantized_psum_tree(
+                grads, "data", world, cfg.comm_dtype,
+                rng=_quant_rng(cfg, local_rng),
+            )
         return loss, grads
 
     return shard_map(
@@ -163,7 +177,17 @@ def _quantized_fsdp_grads(strategy, params, cfg, batch, targets, rng):
     custom vjp compresses the cotangent through the quantized
     reduce-scatter, landing grads directly in the FSDP shard layout.
     Replicated (sub-threshold) leaves ride psum_grad: identity forward,
-    full-precision grad psum."""
+    full-precision grad psum.
+
+    --grad_buckets N >= 1 (round 18): the sharded leaves partition into
+    N ~equal-byte, layer-reversed buckets and each bucket gathers through
+    ONE quant_comm.bucket_gather_qgrad — forward per-leaf full-precision
+    gathers unchanged, backward ONE packed reduce-scatter a2a per BUCKET
+    instead of one per leaf. The bucket vjp fires when its last
+    (earliest-layer) cotangent lands, so each wire launch interleaves
+    with the remaining backward. Replicated leaves stay on the f32 psum
+    path regardless of bucketing (compressing or batching them buys
+    noise, not bandwidth)."""
     from tpukit.compat import shard_map
 
     mesh = strategy.mesh
@@ -173,6 +197,16 @@ def _quantized_fsdp_grads(strategy, params, cfg, batch, targets, rng):
     leaves, treedef = jax.tree_util.tree_flatten(params)
     spec_list = [strategy.param_spec(l.shape) for l in leaves]
     spec_tree = jax.tree_util.tree_unflatten(treedef, spec_list)
+    dim_list = [
+        next((i for i, ax in enumerate(spec) if ax == "data"), None)
+        for spec in spec_list
+    ]
+    buckets = []
+    if cfg.grad_buckets > 0:
+        sharded = {i for i, d in enumerate(dim_list) if d is not None}
+        buckets = quant_comm.grad_bucket_plan(
+            params, cfg.grad_buckets, include=sharded
+        )
 
     def block(p_shards, input_ids, position_ids, mask, tgts):
         local_rng = (
@@ -186,18 +220,26 @@ def _quantized_fsdp_grads(strategy, params, cfg, batch, targets, rng):
 
         def local_loss(ps):
             flat, td = jax.tree_util.tree_flatten(ps)
-            full = []
-            for leaf, spec in zip(flat, spec_list):
-                dims = [i for i, ax in enumerate(spec) if ax == "data"]
-                if not dims:
-                    full.append(quant_comm.psum_grad(leaf, "data"))
-                else:
-                    full.append(
-                        quant_comm.all_gather_qgrad(
-                            leaf, "data", world, dims[0], cfg.comm_dtype,
+            full = [None] * len(flat)
+            for i, (leaf, dim) in enumerate(zip(flat, dim_list)):
+                if dim is None:
+                    full[i] = quant_comm.psum_grad(leaf, "data")
+            if buckets:
+                for idxs in buckets:
+                    gathered = quant_comm.bucket_gather_qgrad(
+                        tuple(flat[i] for i in idxs), "data", world,
+                        tuple(dim_list[i] for i in idxs), cfg.comm_dtype,
+                        quant_comm.DEFAULT_BLOCK, cfg.quant_stochastic,
+                    )
+                    for i, g in zip(idxs, gathered):
+                        full[i] = g
+            else:
+                for i, (leaf, dim) in enumerate(zip(flat, dim_list)):
+                    if dim is not None:
+                        full[i] = quant_comm.all_gather_qgrad(
+                            leaf, "data", world, dim, cfg.comm_dtype,
                             quant_comm.DEFAULT_BLOCK, cfg.quant_stochastic,
                         )
-                    )
             loss_sum, _ = _local_loss_sum(
                 td.unflatten(full), cfg, input_ids, position_ids, mask,
                 tgts, local_rng, fused,
@@ -292,9 +334,10 @@ class Strategy:
         self._validate_comm_dtype(cfg)
 
     def _validate_comm_dtype(self, cfg: gpt.GPTConfig) -> None:
-        """The --comm_dtype gate every validate_config override must also
-        call: a quantized comm dtype on a strategy without hand-wired
-        quantized collectives is a no-op masquerading as a 4x bytes win."""
+        """The --comm_dtype / --grad_buckets gate every validate_config
+        override must also call: a quantized comm dtype or a bucket
+        schedule on a strategy without hand-wired collectives is a no-op
+        masquerading as a wire win."""
         if cfg.comm_dtype != "f32" and not self.quantized_comm:
             raise ValueError(
                 f"--comm_dtype {cfg.comm_dtype}: the {self.name} strategy "
@@ -302,6 +345,24 @@ class Strategy:
                 f"(grad all-reduce), fsdp (grad reduce-scatter) and ep "
                 f"(a2a dispatch payload)"
             )
+        if cfg.grad_buckets > 0 and not self.quantized_comm:
+            raise ValueError(
+                f"--grad_buckets {cfg.grad_buckets}: the {self.name} "
+                f"strategy has no hand-placed grad wire to bucket — "
+                f"supported on ddp (bucketed two-shot all-reduce), fsdp "
+                f"(bucketed grad reduce-scatter) and ep (the per-layer "
+                f"a2a pairs are already bucket-granular)"
+            )
+
+    def _hand_placed(self, cfg: gpt.GPTConfig) -> bool:
+        """True when a quantized-comm strategy's value_and_grad must run
+        its hand-placed shard_map grad block instead of leaving the
+        collectives to GSPMD: a quantized wire, or any bucket schedule
+        (bucketed f32 keeps exact math but hand-places the exchanges so
+        they stay auditable). ONE spelling — DDP and FSDP branching on
+        different predicates here would silently run different
+        schedules."""
+        return cfg.comm_dtype != "f32" or cfg.grad_buckets > 0
 
     def grad_comm(self, cfg: gpt.GPTConfig, param_shapes,
                   backend: str | None = None) -> dict | None:
@@ -315,6 +376,17 @@ class Strategy:
         folds this and `dispatch_comm` into one CommPlan the rule engine
         diffs (DESIGN.md §15) — new strategies declare here, the engine
         audits everywhere."""
+        return None
+
+    def overlap_comm(self, cfg: gpt.GPTConfig, param_shapes) -> dict | None:
+        """Declared overlap expectation of this strategy's train step
+        (round 18, ROADMAP #5): {op: K} meaning at least K collectives of
+        that HLO kind must each have independent compute the scheduler
+        can hide them behind — the promoted hlolint `overlap` rule gates
+        it (analysis/rules.py). None when the schedule is serial (no
+        bucket wire declared). Only bucketed worlds declare: a 1-bucket
+        payload after the whole backward has nothing to overlap with,
+        and claiming otherwise would make the gate a lie."""
         return None
 
     def comm_ops_for(self, cfg: gpt.GPTConfig) -> tuple[str, ...]:
@@ -449,16 +521,17 @@ class DataParallel(Strategy):
         return P("data")
 
     def validate_config(self, cfg: gpt.GPTConfig) -> None:
-        if cfg.comm_dtype != "f32" and cfg.num_experts > 0:
+        if (cfg.comm_dtype != "f32" or cfg.grad_buckets > 0) and cfg.num_experts > 0:
             raise ValueError(
-                f"--comm_dtype {cfg.comm_dtype} under DataParallel "
-                f"requires a dense model: the MoE aux-loss statistics "
-                f"are not psummed by the hand-placed grad block — use "
-                f"ExpertParallel (main-moe.py) for quantized MoE comm"
+                f"--comm_dtype {cfg.comm_dtype} / --grad_buckets "
+                f"{cfg.grad_buckets} under DataParallel requires a dense "
+                f"model: the MoE aux-loss statistics are not psummed by "
+                f"the hand-placed grad block — use ExpertParallel "
+                f"(main-moe.py) for MoE comm"
             )
 
     def comm_ops_for(self, cfg: gpt.GPTConfig) -> tuple[str, ...]:
-        if cfg.comm_dtype != "f32":
+        if self._hand_placed(cfg):
             # the hand-placed two-shot replaces the GSPMD grad all-reduce
             # with a packed a2a + all-gather; scalar loss/count psums keep
             # "all-reduce" in the expected set
@@ -466,20 +539,35 @@ class DataParallel(Strategy):
         return self.comm_ops
 
     def value_and_grad(self, params, cfg: gpt.GPTConfig, batch, targets, rng=None):
-        if cfg.comm_dtype == "f32":
+        if not self._hand_placed(cfg):
             return super().value_and_grad(params, cfg, batch, targets, rng=rng)
         if cfg.num_experts > 0:
             raise ValueError(
-                "--comm_dtype bf16/int8 under DataParallel requires a dense "
-                "model (see DataParallel.validate_config)"
+                "--comm_dtype bf16/int8 / --grad_buckets under DataParallel "
+                "requires a dense model (see DataParallel.validate_config)"
             )
         return _quantized_dp_grads(self, params, cfg, batch, targets, rng)
 
     def grad_comm(self, cfg: gpt.GPTConfig, param_shapes,
                   backend: str | None = None) -> dict | None:
-        """Expected payload of the quantized grad psum: the whole grad tree
-        flattens into ONE two-shot exchange (quant_comm.expected_all_reduce
-        — one packed a2a + one packed all-gather, [world, row] each)."""
+        """Expected payload of the hand-placed grad wire. Serial
+        (grad_buckets 0): the whole grad tree flattens into ONE two-shot
+        exchange (quant_comm.expected_all_reduce — one packed a2a + one
+        packed all-gather, [world, row] each). Bucketed: one two-shot
+        pair per grad_bucket_plan bucket, priced at the bucket payload
+        dtype (f32 included — the bucket schedule is always hand-placed
+        and therefore always predicted)."""
+        if cfg.grad_buckets > 0:
+            buckets = quant_comm.grad_bucket_plan(param_shapes, cfg.grad_buckets)
+            leaves = jax.tree_util.tree_leaves(param_shapes)
+            sizes = [
+                sum(_n_elems(leaves[i].shape) for i in idxs)
+                for idxs in buckets
+            ]
+            return quant_comm.expected_bucketed_all_reduce(
+                sizes, self.mesh.shape["data"], cfg.comm_dtype,
+                backend=backend,
+            )
         if cfg.comm_dtype == "f32":
             return None
         n = sum(
@@ -488,6 +576,19 @@ class DataParallel(Strategy):
         return quant_comm.expected_all_reduce(
             n, self.mesh.shape["data"], cfg.comm_dtype, backend=backend
         )
+
+    def overlap_comm(self, cfg: gpt.GPTConfig, param_shapes) -> dict | None:
+        """The DDP bucket schedule's overlap declaration: every bucket's
+        two-shot pair (its a2a AND its all-gather) must have independent
+        compute scheduled around it — with B >= 2 buckets each exchange
+        depends only on its own leaves' backward, so the rest of the
+        sweep is free to hide the wire."""
+        if cfg.grad_buckets < 2 or param_shapes is None:
+            return None
+        buckets = quant_comm.grad_bucket_plan(param_shapes, cfg.grad_buckets)
+        if len(buckets) < 2:
+            return None
+        return {"all-to-all": len(buckets), "all-gather": len(buckets)}
 
 
 class FSDP(Strategy):
@@ -509,64 +610,107 @@ class FSDP(Strategy):
             self.name = "fsdp-offload"
 
     def validate_config(self, cfg: gpt.GPTConfig) -> None:
-        if cfg.comm_dtype != "f32" and cfg.num_experts > 0:
+        if (cfg.comm_dtype != "f32" or cfg.grad_buckets > 0) and cfg.num_experts > 0:
             raise ValueError(
-                f"--comm_dtype {cfg.comm_dtype} under FSDP requires a "
-                f"dense model: the MoE aux-loss statistics are not "
-                f"psummed by the hand-placed grad block — use "
-                f"ExpertParallel (main-moe.py) for quantized MoE comm"
+                f"--comm_dtype {cfg.comm_dtype} / --grad_buckets "
+                f"{cfg.grad_buckets} under FSDP requires a dense model: "
+                f"the MoE aux-loss statistics are not psummed by the "
+                f"hand-placed grad block — use ExpertParallel "
+                f"(main-moe.py) for MoE comm"
             )
 
     def comm_ops_for(self, cfg: gpt.GPTConfig) -> tuple[str, ...]:
-        if cfg.comm_dtype != "f32":
+        if self._hand_placed(cfg):
             # grads-only first: the grad reduce-scatter becomes a packed
             # a2a; forward param gathers stay full-precision all-gathers
             return ("all-gather", "all-reduce", "all-to-all")
         return self.comm_ops
 
     def value_and_grad(self, params, cfg: gpt.GPTConfig, batch, targets, rng=None):
-        """Default (f32): GSPMD autodiff — per-tensor all-gather at use,
-        grad reduce-scatter, all inserted by the partitioner. bf16/int8
-        (round 12): the hand-placed shard_map block of
-        `_quantized_fsdp_grads` — gather-at-use stays FULL precision, the
-        grad reduce-scatter compresses through ops/quant_comm.py."""
-        if cfg.comm_dtype == "f32":
+        """Default (f32, no buckets): GSPMD autodiff — per-tensor
+        all-gather at use, grad reduce-scatter, all inserted by the
+        partitioner. bf16/int8 (round 12) or --grad_buckets (round 18):
+        the hand-placed shard_map block of `_quantized_fsdp_grads` —
+        gather-at-use stays FULL precision, the grad reduce-scatter
+        compresses (and/or buckets) through ops/quant_comm.py."""
+        if not self._hand_placed(cfg):
             return super().value_and_grad(params, cfg, batch, targets, rng=rng)
         if cfg.num_experts > 0:
             raise ValueError(
-                "--comm_dtype bf16/int8 under FSDP requires a dense model "
-                "(see FSDP.validate_config)"
+                "--comm_dtype bf16/int8 / --grad_buckets under FSDP "
+                "requires a dense model (see FSDP.validate_config)"
             )
         return _quantized_fsdp_grads(self, params, cfg, batch, targets, rng)
 
+    def _sharded_indices(self, param_shapes) -> tuple[list, set]:
+        """(flat leaves, indices of leaves the param_spec shards over
+        `data`) — the subset the bucket plan partitions."""
+        leaves = jax.tree_util.tree_leaves(param_shapes)
+        sharded = {
+            i for i, leaf in enumerate(leaves)
+            if any(ax == "data" for ax in self.param_spec(leaf.shape))
+        }
+        return leaves, sharded
+
     def grad_comm(self, cfg: gpt.GPTConfig, param_shapes,
                   backend: str | None = None) -> dict | None:
-        """Expected payload of the quantized FSDP grad wire: one packed
-        reduce-scatter a2a per SHARDED leaf (replicated sub-threshold
-        leaves psum in f32 and are not audited), plus the full-precision
-        forward param all-gathers (one per sharded leaf, f32 result =
-        the gathered tensor)."""
-        if cfg.comm_dtype == "f32":
+        """Expected payload of the hand-placed FSDP grad wire. Serial:
+        one packed reduce-scatter a2a per SHARDED leaf (replicated
+        sub-threshold leaves psum in f32 and are not audited). Bucketed:
+        one packed a2a per grad_bucket_plan bucket over the sharded
+        subset, priced at the bucket dtype (f32 included). Either way the
+        full-precision forward param all-gathers (one per sharded leaf,
+        f32 result = the gathered tensor) ride alongside."""
+        if not self._hand_placed(cfg):
             return None
         world = self.mesh.shape["data"]
+        leaves, sharded = self._sharded_indices(param_shapes)
+        gather = {
+            "count": len(sharded),
+            # f32 param gather, full tensor result
+            "bytes": sum(_n_elems(leaves[i].shape) * 4 for i in sharded),
+        }
+        if cfg.grad_buckets > 0:
+            buckets = quant_comm.grad_bucket_plan(
+                param_shapes, cfg.grad_buckets, include=sharded
+            )
+            sizes = [
+                sum(_n_elems(leaves[i].shape) for i in idxs)
+                for idxs in buckets
+            ]
+            exp = quant_comm.expected_bucketed_reduce_scatter(
+                sizes, world, cfg.comm_dtype, backend=backend
+            )
+            if not exp:
+                return None
+            return {"all-to-all": exp["all-to-all"], "all-gather": gather}
         a2a = {"count": 0, "bytes": 0}
-        gather = {"count": 0, "bytes": 0}
-        for leaf in jax.tree_util.tree_leaves(param_shapes):
-            spec = self.param_spec(leaf.shape)
-            if not any(ax == "data" for ax in spec):
-                continue
-            n = _n_elems(leaf.shape)
+        for i in sorted(sharded):
             exp = quant_comm.expected_reduce_scatter(
-                n, world, cfg.comm_dtype, backend=backend
+                _n_elems(leaves[i].shape), world, cfg.comm_dtype,
+                backend=backend,
             )
             if exp:
                 a2a["count"] += exp["all-to-all"]["count"]
                 a2a["bytes"] += exp["all-to-all"]["bytes"]
-            gather["count"] += 1
-            gather["bytes"] += n * 4  # f32 param gather, full tensor result
         if not a2a["count"]:
             return None
         return {"all-to-all": a2a, "all-gather": gather}
+
+    def overlap_comm(self, cfg: gpt.GPTConfig, param_shapes) -> dict | None:
+        """The FSDP bucket schedule's overlap declaration: every bucket's
+        backward reduce-scatter a2a must have independent compute around
+        it. Forward param gathers are at-use by design (serial on the
+        critical path) and are NOT declared."""
+        if cfg.grad_buckets < 2 or param_shapes is None:
+            return None
+        _, sharded = self._sharded_indices(param_shapes)
+        buckets = quant_comm.grad_bucket_plan(
+            param_shapes, cfg.grad_buckets, include=sharded
+        )
+        if len(buckets) < 2:
+            return None
+        return {"all-to-all": len(buckets)}
 
     def param_spec(self, shape: tuple[int, ...]) -> P:
         axis_size = self.mesh.shape["data"]
@@ -1051,6 +1195,14 @@ class ExpertParallel(Strategy):
                 f"pallas (the xla dispatch leaves its collectives to GSPMD, "
                 f"which cannot carry the packed int8 payload)"
             )
+        if cfg.grad_buckets > 0 and self.dispatch == "xla":
+            raise ValueError(
+                f"--grad_buckets {cfg.grad_buckets} under ExpertParallel "
+                f"needs the hand-placed exchange: use --moe_dispatch a2a "
+                f"or pallas (the xla dispatch leaves its collectives to "
+                f"GSPMD — there is no hand-placed schedule to declare "
+                f"overlap for)"
+            )
 
     def to_compute(self, tree):
         """Gather the sharded dense trunk ONCE at the top of each jitted
@@ -1125,6 +1277,23 @@ class ExpertParallel(Strategy):
             cfg, self.data_size, self.expert_size, global_batch, seq,
             backend=backend,
         )
+
+    def overlap_comm(self, cfg: gpt.GPTConfig, param_shapes) -> dict | None:
+        """EP's grad wire is already bucket-granular: the a2a exchange is
+        hand-placed PER LAYER (dispatch + combine, forward and backward —
+        4L a2as per train step), so --grad_buckets under EP changes no
+        dataflow; any value >= 1 DECLARES the overlap audit instead. The
+        declaration covers the 2L backward hops: each backward a2a has
+        the other layers' weight-grad accumulation independent of it (the
+        dW branches neither feed nor consume another layer's exchange),
+        which is the compute the scheduler hides the wire behind. The
+        forward chain is honestly serial (layer i+1's tokens need layer
+        i's combine) and is not declared."""
+        if cfg.grad_buckets < 1 or self.expert_size <= 1:
+            return None
+        if cfg.num_experts <= 0 or self.dispatch == "xla":
+            return None
+        return {"all-to-all": 2 * cfg.num_layers}
 
     def _spec_for(self, names: tuple[str, ...], shape: tuple[int, ...]) -> P:
         if "experts" in names:

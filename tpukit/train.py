@@ -469,6 +469,7 @@ def _fit_body(
         router_top_k=flags.moe_top_k,
         comm_dtype=flags.comm_dtype,
         quant_stochastic=flags.quant_stochastic,
+        grad_buckets=flags.grad_buckets,
     )
     optimizer = make_optimizer(flags.learning_rate)
     strategy.validate_config(cfg)  # fail fast with a clear shape/mesh error

@@ -38,7 +38,13 @@ p50/p99 end-to-end and per-token latency, slot occupancy. The
 decoding — induction-trained target, self-spec (fused on-device n-gram)
 and draft-model proposers — vs the vanilla engine on the repetitive
 stream at temperature 0 and 0.8: tokens/s (self-spec t=0 >= 1.3x is the
-bar), acceptance rate, and the appended-tokens/verify histogram.
+bar), acceptance rate, and the appended-tokens/verify histogram. The
+`fleet_serving` record (round 19, ROADMAP #1) measures the fleet router
+(tpukit/serve/fleet) at 1 vs 2 vs 4 replicas on the same stream at equal
+total devices — fleet tokens/s scaling (>1.5x at 2 replicas is the bar),
+p99 under load, per-request token parity across rungs, and
+disaggregated-vs-colocated prefill admit latency — with an honest
+CPU-loopback caveat in-record.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -679,6 +685,154 @@ def bench_paged_kv(cfg, n_dev, requests=24, max_new=12, slots=4):
             "admit_latency_hit_s": psum.get("admit_latency_hit_s"),
             "admit_latency_cold_s": psum.get("admit_latency_cold_s"),
         },
+    }
+
+
+def bench_fleet_serving(cfg, n_dev, requests=32, slots=4, max_new=12):
+    """Fleet scaling curve (round 19, ROADMAP #1): 1 vs 2 vs 4 engine
+    replicas on the SAME seeded stream at EQUAL total devices — the
+    router's capacity story. Each rung carves the device list into
+    disjoint per-replica subsets (8 devices = 1x8, 2x4, 4x2; grids from
+    `fleet.pick_serve_grid`), serves the identical stream, and reports
+    fleet tokens/s, p99 e2e under load, and per-request token parity vs
+    the 1-replica rung (the fleet bar: routing must never change a
+    token). The 2-replica rung is the acceptance rung (>1.5x the
+    1-replica tokens/s at equal total devices).
+
+    The second half measures DISAGGREGATED vs COLOCATED prefill on the
+    2-replica paged configuration over a shared-system-prompt stream:
+    mean admit latency (slot-assignment to decode-ready — what moving
+    prefill off the decode replicas buys them) plus handoff/prefix-hit
+    counts.
+
+    HONEST CPU CAVEAT (in-record as `caveat`, the comm_overlap
+    discipline): on virtual CPU devices the per-replica "grids" share
+    host cores and collectives are loopback memcpys, so the scaling
+    curve measures the ROUTER (scheduling, admission, dispatch overlap
+    across subsets), not interconnect physics; on real chips the
+    per-replica model-parallel speedup stacks on top. With fewer than 4
+    devices the rungs run meshless replicas (router identical, grids
+    trivial)."""
+    import time
+
+    import jax
+
+    from tpukit.data import get_tokenizer
+    from tpukit.model import init_params
+    from tpukit.serve import (
+        FleetConfig,
+        FleetRouter,
+        ServeConfig,
+        synthetic_request_stream,
+    )
+
+    import jax.numpy as jnp
+
+    tokenizer = get_tokenizer()
+    tokenizer.pad_token_id = 2
+    cfg = cfg.replace(vocab_size=tokenizer.vocab_size,
+                      compute_dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
+    buckets = lengths = (8, 16)
+    eos = int(tokenizer.eos_token_id)
+    stream = synthetic_request_stream(
+        tokenizer, requests, seed=0, max_new_tokens=max_new,
+        buckets=buckets, lengths=lengths,
+    )
+    serve = ServeConfig(slots=slots, buckets=buckets, max_new_tokens=max_new,
+                        window_steps=10**9)
+    meshed = n_dev >= 4
+
+    def run_fleet(n_replicas, fleet_kw=None, serve_cfg=None, reqs=None):
+        fc = FleetConfig(
+            replicas=n_replicas,
+            devices_per_replica=(n_dev // n_replicas) if meshed else 0,
+            window_steps=10**9, **(fleet_kw or {}),
+        )
+        sv = serve_cfg or serve
+        FleetRouter(host, cfg, sv, fc, eos_id=eos).run(
+            list(reqs or stream), max_wall_s=900)  # warm compiles
+        router = FleetRouter(host, cfg, sv, fc, eos_id=eos)
+        t0 = time.perf_counter()
+        comps = router.run(list(reqs or stream), max_wall_s=900)
+        wall = time.perf_counter() - t0
+        gen = sum(c.generated for c in comps)
+        e2e = np.asarray([c.e2e_s for c in comps])
+        admit = [c.admit_latency_s for c in comps]
+        return dict(
+            replicas=n_replicas,
+            devices_per_replica=fc.devices_per_replica,
+            tokens_per_sec=round(gen / wall, 1), wall_s=round(wall, 3),
+            generated_tokens=gen,
+            p50_e2e_s=round(float(np.percentile(e2e, 50)), 4),
+            p99_e2e_s=round(float(np.percentile(e2e, 99)), 4),
+            mean_admit_latency_s=round(float(np.mean(admit)), 5),
+        ), {c.rid: list(map(int, c.ids)) for c in comps}, router.last_summary
+
+    rungs, toks = [], {}
+    for n_replicas in (1, 2, 4):
+        if n_replicas > max(requests, 1):
+            continue
+        try:
+            rec, t, _ = run_fleet(n_replicas)
+            rungs.append(rec)
+            toks[n_replicas] = t
+        except Exception as exc:  # per-rung failures land in-record
+            rungs.append({"replicas": n_replicas, "error": repr(exc)})
+    parity = (1 in toks) and all(toks[n] == toks[1] for n in toks)
+    by_n = {r["replicas"]: r for r in rungs if "error" not in r}
+    scaling = (
+        round(by_n[2]["tokens_per_sec"] / by_n[1]["tokens_per_sec"], 2)
+        if 1 in by_n and 2 in by_n and by_n[1]["tokens_per_sec"] else None
+    )
+
+    # disaggregated vs colocated prefill: 2 replicas, paged pools, one
+    # shared system prompt — what a dedicated prefill worker buys the
+    # decode replicas' admit latency
+    disagg = None
+    try:
+        page = 8
+        paged_cfg = ServeConfig(
+            slots=slots, buckets=buckets, max_new_tokens=max_new,
+            window_steps=10**9, page_size=page,
+        )
+        shared = synthetic_request_stream(
+            tokenizer, requests, seed=0, max_new_tokens=max_new,
+            buckets=buckets, lengths=lengths, shared_prefix=page,
+        )
+        colo, _, _ = run_fleet(2, serve_cfg=paged_cfg, reqs=shared)
+        dis, _, dsum = run_fleet(
+            2, fleet_kw=dict(disagg_prefill=True), serve_cfg=paged_cfg,
+            reqs=shared,
+        )
+        dp = (dsum or {}).get("disagg_prefill") or {}
+        disagg = dict(
+            colocated_admit_latency_s=colo["mean_admit_latency_s"],
+            disagg_admit_latency_s=dis["mean_admit_latency_s"],
+            colocated_tokens_per_sec=colo["tokens_per_sec"],
+            disagg_tokens_per_sec=dis["tokens_per_sec"],
+            handoffs=dp.get("handoffs"),
+            worker_prefix_hits=dp.get("worker_prefix_hits"),
+        )
+    except Exception as exc:
+        disagg = {"error": repr(exc)}
+
+    return {
+        "requests": requests, "slots_per_replica": slots,
+        "buckets": list(buckets), "max_new_tokens": max_new,
+        "total_devices": n_dev, "meshed": meshed,
+        "rungs": rungs,
+        "parity_ok": bool(parity),
+        "scaling_2x_vs_1": scaling,
+        "disagg_prefill": disagg,
+        "caveat": (
+            "CPU virtual devices: per-replica grids share host cores and "
+            "collectives are loopback memcpys — the curve measures router "
+            "scheduling + dispatch overlap, not interconnect physics"
+            + ("" if meshed else "; <4 devices, so rungs ran MESHLESS "
+               "replicas (trivial grids)")
+        ),
     }
 
 
@@ -1387,6 +1541,17 @@ def main(argv=None):
         spec_decode_rec = {"error": repr(exc)}
         print(f"spec decode probe failed: {exc!r}", file=sys.stderr)
 
+    # Fleet serving (round 19, ROADMAP #1): 1 vs 2 vs 4 replicas on the
+    # same stream at equal total devices — fleet tokens/s scaling (>1.5x
+    # at 2 replicas is the bar), p99 under load, per-request parity, and
+    # disaggregated-vs-colocated prefill admit latency.
+    fleet_serving_rec = None
+    try:
+        fleet_serving_rec = bench_fleet_serving(cfg, n_dev)
+    except Exception as exc:
+        fleet_serving_rec = {"error": repr(exc)}
+        print(f"fleet serving probe failed: {exc!r}", file=sys.stderr)
+
     # Host input pipeline (round 7): sync data+h2d share vs the depth-2
     # prefetcher's residual stall share, with loss-parity proof.
     host_pipeline, host_pipeline_err = None, None
@@ -1446,6 +1611,7 @@ def main(argv=None):
         "serving": serving_rec,
         "paged_kv": paged_kv_rec,
         "spec_decode": spec_decode_rec,
+        "fleet_serving": fleet_serving_rec,
         "host_pipeline": host_pipeline,
         "host_pipeline_error": host_pipeline_err,
         "obs_overhead": obs_overhead,

@@ -43,6 +43,20 @@ runs a small tpukit GPT draft (`--draft_checkpoint` + `--draft_*` shape
 flags, params-only restore with its own ledger line) with its own
 replicated KV ring. Speculation needs the ring cache (page_size 0).
 
+Round 19 (ROADMAP #1, tpukit/serve/fleet.py): `--replicas N` routes the
+stream through a FLEET — N engine replicas, each on its own disjoint
+device subset (`--devices_per_replica`, model-parallel grid per
+replica), behind one least-loaded router. The checkpoint is read ONCE
+(host-side params-only restore) and placed per replica; fleet output is
+token-identical to a single engine on the same stream, including when
+`--fleet_kill replica_kill@R[:idx]` chaos-kills a replica mid-stream
+(in-flight requests re-queue onto survivors, exactly-once output).
+`--disagg_prefill` dedicates a prefill worker that hands finished
+prefixes to decode replicas as pages; `--scale_up_occupancy` /
+`--scale_down_occupancy` autoscale the replica count between fleet
+windows. `kind="fleet"` telemetry renders via tools/report.py
+"== fleet ==" with `--min_fleet_tps` as the CI gate.
+
 Run examples:
   python main-serve.py --requests 64 --slots 8 --metrics_log serve.jsonl
   python main-serve.py --checkpoint latest --temperature 0.8 --top_k 40
@@ -55,6 +69,9 @@ Run examples:
   python main-serve.py --draft model \\
       --draft_checkpoint ckpts_draft/checkpoint-step000002000.msgpack \\
       --draft_dim 64 --draft_num_layers 2   # draft-model speculation
+  python main-serve.py --replicas 2 --devices_per_replica 4 \\
+      --fleet_kill replica_kill@40:1 \\
+      --metrics_log fleet.jsonl   # fleet router + chaos replica kill
 """
 
 import argparse
@@ -87,9 +104,12 @@ def parse_serve_flags(argv=None):
                     "(smoke/bench mode)")
     ap.add_argument("--seed", type=int, default=0)
     # engine shape (shared with bench.py via tpukit.flags.add_serve_flags)
-    from tpukit.flags import add_serve_flags
+    from tpukit.flags import add_fleet_flags, add_serve_flags
 
     add_serve_flags(ap)
+    # fleet router (round 19): --replicas N routes the stream over N
+    # engine replicas on disjoint device subsets; 0 = single engine
+    add_fleet_flags(ap)
     # stream
     ap.add_argument("--requests", type=int, default=64)
     ap.add_argument("--qps", type=float, default=0.0,
@@ -129,34 +149,14 @@ def parse_serve_flags(argv=None):
 
 def pick_serve_grid(n_devices: int, heads: int, slots: int,
                     paged: bool = False) -> dict:
-    """(data x model) serving grid: the largest model degree <= 4 dividing
-    both the device count and the head count (the KV ring shards heads
-    over `model`; main-tp.py's rule), remaining devices data-parallel —
-    shrunk to the largest divisor of the slot count, since slots shard
-    over `data`. Paged serving (round 15) requires a MODEL-ONLY grid —
-    the page pool is replicated across `data`, so a data axis > 1 would
-    make the pool write-back an unauditable cross-shard scatter
-    (serve.decode.decode_step_comm) — and therefore drops the <= 4 cap:
-    `model` grows to the LARGEST head-dividing degree so devices the
-    ring would have used as `data` aren't silently stranded."""
-    if paged:
-        # data is pinned to 1, so n_devices divisibility buys nothing —
-        # create_mesh takes a device subset when model < n_devices; only
-        # the head count constrains the degree
-        for model in range(min(n_devices, heads), 0, -1):
-            if heads % model == 0:
-                if model < n_devices:
-                    print(f"paged serving uses a model-only grid: "
-                          f"model={model} of {n_devices} devices "
-                          f"(model degree is capped by heads={heads})")
-                return {"data": 1, "model": model}
-    for model in (4, 2, 1):
-        if n_devices % model == 0 and heads % model == 0:
-            data = n_devices // model
-            while data > 1 and slots % data:
-                data -= 1
-            return {"data": data, "model": model}
-    return {"data": 1, "model": 1}
+    """The grid picker moved to tpukit/serve/fleet.py in round 19 (the
+    fleet builds one grid PER REPLICA over each replica's device subset,
+    so it is shared infrastructure now); this thin delegate keeps the
+    name callers and docs know, and the lazy import keeps this module's
+    import side-effect-free like the rest of the recipe CLI."""
+    from tpukit.serve.fleet import pick_serve_grid as _pick
+
+    return _pick(n_devices, heads, slots, paged=paged)
 
 
 def main(argv=None):
@@ -195,6 +195,10 @@ def main(argv=None):
         moe_dispatch=flags.moe_dispatch if flags.num_experts > 0 else "xla",
     )
     buckets = tuple(sorted({int(b) for b in flags.buckets.split(",") if b}))
+
+    # ---- fleet mode (round 19, --replicas >= 1) --------------------------
+    if flags.replicas > 0:
+        return _run_fleet(flags, cfg, tokenizer, buckets)
 
     # ---- serving mesh + params at their training shardings ---------------
     # Dense models serve TensorParallel (heads over `model`); MoE
@@ -406,6 +410,160 @@ def main(argv=None):
                 np.asarray(c.ids), skip_special_tokens=True))
         if flags.metrics_log:
             print(f"serve telemetry -> {flags.metrics_log} "
+                  f"(render: python tools/report.py {flags.metrics_log})")
+    logger.close()
+    return 0
+
+
+def _run_fleet(flags, cfg, tokenizer, buckets) -> int:
+    """Fleet serving (round 19, ROADMAP #1): route the stream over
+    `--replicas` ServeEngine replicas on disjoint device subsets via
+    `tpukit/serve/fleet.FleetRouter`. The checkpoint cold start is SHARED:
+    `checkpoint.restore_params(..., sharding_tree=None)` reads the bytes
+    ONCE into host arrays, and every replica placement is a device_put of
+    that one copy — the `kind="ckpt_restore"` ledger records bytes_read
+    once with the placement count alongside, so N replicas never imply
+    N checkpoint reads."""
+    import time
+    from functools import partial
+
+    import jax
+    import numpy as np
+
+    from tpukit import checkpoint as ckpt_lib
+    from tpukit.mesh import is_process_zero
+    from tpukit.obs import FlightRecorder, StepLogger
+    from tpukit.serve import (
+        FleetConfig,
+        FleetRouter,
+        ServeConfig,
+        synthetic_request_stream,
+    )
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import create_train_state, make_optimizer
+
+    if flags.draft == "model":
+        raise ValueError(
+            "--replicas with --draft model is a future round (the draft "
+            "params would need their own per-replica placement); "
+            "--draft ngram (self-speculation, no second model) runs per "
+            "replica today"
+        )
+    serve = ServeConfig(
+        slots=flags.slots, buckets=buckets,
+        max_new_tokens=flags.max_new_tokens,
+        temperature=flags.temperature, top_k=flags.top_k,
+        window_steps=flags.window_steps,
+        page_size=flags.page_size, num_pages=flags.num_pages,
+        kv_dtype=flags.kv_dtype, prefill_chunk=flags.prefill_chunk,
+        draft=flags.draft, spec_k=flags.spec_k, ngram_max=flags.ngram_max,
+    )
+    fleet = FleetConfig(
+        replicas=flags.replicas,
+        devices_per_replica=flags.devices_per_replica,
+        min_replicas=flags.min_replicas, max_replicas=flags.max_replicas,
+        scale_up_occupancy=flags.scale_up_occupancy,
+        scale_down_occupancy=flags.scale_down_occupancy,
+        window_steps=flags.fleet_window_steps,
+        disagg_prefill=flags.disagg_prefill,
+        prefill_slots=flags.prefill_slots, prefill_pages=flags.prefill_pages,
+        kill_spec=flags.fleet_kill,
+    )
+    logger = StepLogger(flags.metrics_log)
+    recorder = FlightRecorder()
+    p0 = is_process_zero()
+
+    # Shapes only (strategy-independent): the template for the params-only
+    # host read. Nothing is materialized here.
+    optimizer = make_optimizer(1e-4)
+    init_fn = partial(create_train_state, cfg=cfg, optimizer=optimizer,
+                      strategy=SingleDevice())
+    state_shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(flags.seed))
+
+    path = rs_info = None
+    if flags.checkpoint:
+        path = (ckpt_lib.latest_any() if flags.checkpoint == "latest"
+                else flags.checkpoint)
+        if path is None:
+            raise FileNotFoundError("--checkpoint latest: no checkpoint found")
+        ok, detail = ckpt_lib.verify_checkpoint(path)
+        if not ok:
+            raise RuntimeError(f"--checkpoint {path}: failed integrity "
+                               f"verification ({detail})")
+        try:
+            # sharding_tree=None keeps the leaves on HOST — the one read
+            params_host, rs_info = ckpt_lib.restore_params(
+                path, state_shapes.params, None
+            )
+        except ValueError as exc:
+            raise ValueError(
+                f"--checkpoint {path}: state structure does not match "
+                f"the model flags (--dim/--heads/--num_layers/"
+                f"--num_experts... must equal the training run's). "
+                f"Original error: {exc}"
+            ) from exc
+    else:
+        params_host = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x)),
+            jax.jit(lambda r: init_fn(r).params)(jax.random.PRNGKey(flags.seed)),
+        )
+        if p0:
+            print("serving fresh seeded params (no --checkpoint)")
+
+    router = FleetRouter(params_host, cfg, serve, fleet,
+                         eos_id=int(tokenizer.eos_token_id),
+                         logger=logger, recorder=recorder)
+    if path is not None:
+        rec = dict(kind="ckpt_restore", params_only=True, fleet=True,
+                   checkpoint=str(path), replicas=flags.replicas,
+                   placements=router.placements, **rs_info)
+        logger.log(**rec)
+        recorder.record("ckpt_restore", params_only=True, fleet=True,
+                        placements=router.placements)
+        if p0:
+            print(f"fleet cold start from {path}: "
+                  f"{rs_info['bytes_read']} B read ONCE, "
+                  f"{router.placements} placement(s) for "
+                  f"{flags.replicas} replica(s)"
+                  + (" + prefill worker" if fleet.disagg_prefill else ""))
+
+    requests = synthetic_request_stream(
+        tokenizer, flags.requests, seed=flags.seed,
+        max_new_tokens=flags.max_new_tokens, buckets=buckets, qps=flags.qps,
+        shared_prefix=flags.shared_prefix,
+        stream_profile=flags.stream_profile,
+    )
+    t0 = time.perf_counter()
+    completions = router.run(requests)
+    wall = time.perf_counter() - t0
+
+    if p0:
+        s = router.last_summary or {}
+        gen = sum(c.generated for c in completions)
+        print(f"fleet served {len(completions)} requests / {gen} tokens in "
+              f"{wall:.2f}s ({gen / wall:.1f} tokens/s) over "
+              f"{s.get('replicas_final', '?')} replica(s) "
+              f"(peak {s.get('replicas_peak', '?')})")
+        if s.get("kills") or s.get("requeued"):
+            print(f"  failures: {s.get('kills', 0)} replica kill(s), "
+                  f"{s.get('requeued', 0)} request(s) re-queued, "
+                  f"{s.get('duplicate_completions', 0)} duplicate "
+                  f"completion(s)")
+        if s.get("scale_ups") or s.get("scale_downs"):
+            print(f"  autoscale: {s.get('scale_ups', 0)} up / "
+                  f"{s.get('scale_downs', 0)} down")
+        if fleet.disagg_prefill:
+            d = s.get("disagg_prefill") or {}
+            print(f"  disaggregated prefill: {d.get('handoffs', 0)} "
+                  f"handoffs, {d.get('worker_prefix_hits', 0)} worker "
+                  f"prefix hits, {d.get('worker_pages_reused', 0)} pages "
+                  f"of prefill skipped")
+        p50, p99 = s.get("p50_e2e_s"), s.get("p99_e2e_s")
+        if p50 is not None:
+            print(f"  e2e latency p50 {1e3 * p50:.1f} ms  "
+                  f"p99 {1e3 * p99:.1f} ms")
+        if flags.metrics_log:
+            print(f"fleet telemetry -> {flags.metrics_log} "
                   f"(render: python tools/report.py {flags.metrics_log})")
     logger.close()
     return 0

@@ -1,0 +1,473 @@
+"""Fleet serving: the request router over N engine replicas
+(tpukit/serve/fleet, round 19, ROADMAP #1).
+
+Contracts pinned here:
+  - fleet output is TOKEN-IDENTICAL to a single engine consuming the same
+    seeded stream — greedy and fixed-seed sampled, all-at-once and under
+    staggered `--qps` arrivals — because per-request seeds ride the
+    Request and every replica is the proven round-14 engine;
+  - a chaos-killed replica's in-flight requests re-queue onto survivors
+    (prompt reconstructed from the Request — completion-carries-prompt)
+    and every request's tokens are emitted EXACTLY once, still
+    token-identical to the un-killed run;
+  - N replicas x model-parallel grids coexist on disjoint device subsets
+    of the one process, one params placement per subset from ONE host
+    copy (the shared-cold-start ledger);
+  - disaggregated prefill: decode replicas never run a prefill program
+    (compile budget shrinks to decode + the adopt arm), the handoff's
+    decode-side registry claims survive prefill-pool pressure (refcounted
+    pages are never reclaimed under a reader), and parity holds;
+  - occupancy-driven autoscale grows under load and drains when idle,
+    with parity throughout;
+  - `kind="fleet"`/`fleet_summary` JSONL lands, `tools/report.py` renders
+    the "== fleet ==" section, and the `--min_fleet_tps` gate fails on
+    fleet-less logs, sub-threshold throughput, and exactly-once
+    violations.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpukit import chaos as chaos_lib
+from tpukit.data import WordTokenizer, synthetic_stories
+from tpukit.model import GPTConfig, init_params
+from tpukit.serve import (
+    FleetConfig,
+    FleetRouter,
+    Request,
+    ServeConfig,
+    ServeEngine,
+    synthetic_request_stream,
+)
+from tpukit.serve import decode as serve_decode
+from tpukit.serve.paged import PageAllocator
+
+MAX_NEW = 10
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return WordTokenizer(synthetic_stories(64))
+
+
+@pytest.fixture(scope="module")
+def cfg(tok):
+    return GPTConfig(
+        dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=tok.vocab_size,
+        max_position_embeddings=64, compute_dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(jax.random.PRNGKey(1), cfg)
+
+
+@pytest.fixture(scope="module")
+def host_params(params):
+    """ONE host-side copy — what `restore_params(..., None)` hands the
+    router in production; every replica placement is a device_put of it."""
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
+
+
+def _tokens(comps):
+    return {c.rid: list(map(int, c.ids)) for c in comps}
+
+
+def _single_engine_tokens(params, cfg, tok, serve, reqs):
+    eng = ServeEngine(params, cfg, serve, eos_id=int(tok.eos_token_id))
+    return _tokens(eng.run(list(reqs), max_wall_s=300))
+
+
+# ---------------------------------------------------------------------------
+# Parity: fleet == single engine on the same stream, greedy and sampled,
+# all-at-once and under staggered arrivals.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "temperature,top_k,qps",
+    [(0.0, 0, 0.0), (0.9, 5, 0.0), (0.9, 5, 50.0), (0.0, 0, 50.0)],
+    ids=["greedy", "sampled", "sampled_qps", "greedy_qps"],
+)
+def test_fleet_matches_single_engine(tok, cfg, params, host_params,
+                                     temperature, top_k, qps):
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        temperature=temperature, top_k=top_k, window_steps=8)
+    reqs = synthetic_request_stream(tok, 8, seed=3, max_new_tokens=MAX_NEW,
+                                    buckets=(8, 16), qps=qps)
+    want = _single_engine_tokens(params, cfg, tok, serve, reqs)
+    router = FleetRouter(host_params, cfg, serve,
+                         FleetConfig(replicas=2, window_steps=4),
+                         eos_id=int(tok.eos_token_id))
+    got = _tokens(router.run(list(reqs), max_wall_s=300))
+    assert got.keys() == want.keys()
+    for rid in want:
+        np.testing.assert_array_equal(got[rid], want[rid], err_msg=f"rid {rid}")
+    s = router.last_summary
+    assert s["requests"] == 8 and s["duplicate_completions"] == 0
+    assert s["kills"] == 0 and s["requeued"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Replica failure: killed mid-stream, in-flight requests re-queue onto the
+# survivor, exactly-once output, tokens unchanged.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("temperature,top_k", [(0.0, 0), (0.9, 5)],
+                         ids=["greedy", "sampled"])
+def test_fleet_kill_requeues_exactly_once(tok, cfg, params, host_params,
+                                          temperature, top_k):
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        temperature=temperature, top_k=top_k, window_steps=8)
+    reqs = synthetic_request_stream(tok, 8, seed=3, max_new_tokens=MAX_NEW,
+                                    buckets=(8, 16))
+    want = _single_engine_tokens(params, cfg, tok, serve, reqs)
+    router = FleetRouter(
+        host_params, cfg, serve,
+        FleetConfig(replicas=2, window_steps=4,
+                    kill_spec="replica_kill@1:1"),
+        eos_id=int(tok.eos_token_id))
+    comps = router.run(list(reqs), max_wall_s=300)
+    got = _tokens(comps)
+    # exactly once: 8 completions, 8 distinct rids
+    assert len(comps) == 8 and got.keys() == want.keys()
+    for rid in want:
+        np.testing.assert_array_equal(got[rid], want[rid], err_msg=f"rid {rid}")
+    s = router.last_summary
+    assert s["kills"] == 1 and s["requeued"] >= 1
+    assert s["duplicate_completions"] == 0
+    assert s["per_replica"][1]["fate"] == "killed"
+
+
+def test_fleet_never_kills_last_replica(tok, cfg, host_params):
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=8,
+                        window_steps=8)
+    reqs = synthetic_request_stream(tok, 4, seed=2, max_new_tokens=8,
+                                    buckets=(8, 16))
+    router = FleetRouter(
+        host_params, cfg, serve,
+        FleetConfig(replicas=2, window_steps=4,
+                    kill_spec="replica_kill@0:1,replica_kill@1:0"),
+        eos_id=int(tok.eos_token_id))
+    comps = router.run(list(reqs), max_wall_s=300)
+    # the second kill targets the ONLY survivor and must be refused
+    assert len(comps) == 4
+    assert router.last_summary["kills"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Device subsets: N replicas x model-parallel grids in one process, one
+# placement per subset from one host copy.
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_subset_meshes_coexist(tok, cfg, params, host_params):
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=6,
+                        window_steps=8)
+    reqs = synthetic_request_stream(tok, 6, seed=5, max_new_tokens=6,
+                                    buckets=(8, 16))
+    want = _single_engine_tokens(params, cfg, tok, serve, reqs)
+    router = FleetRouter(host_params, cfg, serve,
+                         FleetConfig(replicas=2, devices_per_replica=2,
+                                     window_steps=4),
+                         eos_id=int(tok.eos_token_id))
+    # disjoint subsets, model-parallel grid per replica
+    devs = [tuple(d.id for d in np.ravel(e.mesh.devices))
+            for e in router._replicas.values()]
+    assert devs[0] != devs[1] and not (set(devs[0]) & set(devs[1]))
+    for e in router._replicas.values():
+        assert e.mesh.shape["model"] == 2
+    got = _tokens(router.run(list(reqs), max_wall_s=600))
+    for rid in want:
+        np.testing.assert_array_equal(got[rid], want[rid], err_msg=f"rid {rid}")
+    # one placement per subset, from ONE shared host copy
+    assert router.last_summary["params_placements"] == 2
+
+
+def test_fleet_cold_start_ledger(tok, cfg, tmp_path):
+    """The shared cold start: the checkpoint is read ONCE into host
+    arrays, and N replicas cost N placements (meshless replicas share a
+    single committed copy — placements == 1) — never N reads."""
+    from tpukit import checkpoint as ck
+    from tpukit.train import create_train_state, make_optimizer
+
+    state = create_train_state(jax.random.PRNGKey(0), cfg,
+                               make_optimizer(1e-4))
+    path = ck.save_auto(state, tmp_path, "checkpoint-step5",
+                        format="sharded")
+    template = jax.eval_shape(lambda: state).params
+    # ONE read (no sharding tree): this is the fleet path — the bytes are
+    # paid here and never again; every replica placement below is a pure
+    # device_put of this copy
+    host, info = ck.restore_params(path, template, None)
+    assert info["bytes_read"] > 0 and info["bytes_skipped"] > info["bytes_read"]
+    serve = ServeConfig(slots=2, buckets=(8,), max_new_tokens=4,
+                        window_steps=8)
+    reqs = synthetic_request_stream(tok, 3, seed=1, max_new_tokens=4,
+                                    buckets=(8,))
+    # meshless: all replicas SHARE one committed copy — N-1 placements free
+    router = FleetRouter(host, cfg, serve, FleetConfig(replicas=3),
+                         eos_id=int(tok.eos_token_id))
+    assert router.placements == 1
+    comps = router.run(list(reqs), max_wall_s=300)
+    assert len(comps) == 3
+    assert router.last_summary["params_placements"] == 1
+    # meshed: one placement per subset
+    router2 = FleetRouter(host, cfg, serve,
+                          FleetConfig(replicas=2, devices_per_replica=2),
+                          eos_id=int(tok.eos_token_id))
+    assert router2.placements == 2
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill: handoff parity, the shrunk decode compile budget,
+# and the write-safety of decode-side claims under pool pressure.
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_prefill_parity_and_compile_budget(tok, cfg, params,
+                                                  host_params):
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        window_steps=8, page_size=8)
+    reqs = synthetic_request_stream(tok, 8, seed=3, max_new_tokens=MAX_NEW,
+                                    buckets=(8, 16), shared_prefix=8)
+    want = _single_engine_tokens(params, cfg, tok, serve, reqs)
+    adopt0 = serve_decode.adopt_slot._cache_size()
+    chunk0 = serve_decode.prefill_chunk_paged._cache_size()
+    router = FleetRouter(host_params, cfg, serve,
+                         FleetConfig(replicas=2, window_steps=4,
+                                     disagg_prefill=True),
+                         eos_id=int(tok.eos_token_id))
+    replicas = list(router._replicas.values())
+    got = _tokens(router.run(list(reqs), max_wall_s=600))
+    assert got.keys() == want.keys()
+    for rid in want:
+        np.testing.assert_array_equal(got[rid], want[rid], err_msg=f"rid {rid}")
+    s = router.last_summary
+    dp = s["disagg_prefill"]
+    assert dp["handoffs"] == 8 and dp["worker_admitted"] == 8
+    assert dp["worker_prefix_hits"] > 0  # the shared system prompt hit
+    # decode replicas NEVER ran a prefill: their compile budget is the
+    # decode program + the adopt arm. The worker owns every chunk program.
+    for eng in replicas:
+        assert eng.spans.epoch()["seconds"].get("prefill", 0.0) == 0.0
+    assert serve_decode.adopt_slot._cache_size() - adopt0 <= 1
+    # chunk compiles bounded by the WORKER's power-of-two admit sizes
+    worker_sizes = (router.prefill.serve.slots - 1).bit_length() + 1
+    assert (serve_decode.prefill_chunk_paged._cache_size() - chunk0
+            <= worker_sizes)
+
+
+def test_disagg_claims_survive_prefill_pool_pressure(tok, cfg, params,
+                                                     host_params):
+    """The handoff safety invariant: decode-side pages backing live lanes
+    are refcounted (claimed/owned) and can never be reclaimed, however
+    hard the PREFILL pool is pressed — a tiny worker pool that must
+    reclaim its retained prefix pages between admissions still produces
+    token-exact completions on the decode side."""
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        window_steps=8, page_size=8)
+    # worker pool: exactly one worst-case request + null page, so UNIQUE
+    # prompts interleaved with the shared-prefix ones force the worker's
+    # retained prefix pages out between admissions (reclaim pressure) —
+    # while the decode side keeps claiming its own registered copies
+    min_pages = -(-(16 + MAX_NEW) // 8) + 1
+    shared = synthetic_request_stream(tok, 6, seed=3, max_new_tokens=MAX_NEW,
+                                      buckets=(8, 16), shared_prefix=8)
+    unique = synthetic_request_stream(tok, 4, seed=11, max_new_tokens=MAX_NEW,
+                                      buckets=(8, 16))
+    reqs = list(shared)
+    for i, r in enumerate(unique):
+        reqs.insert(2 * i + 1, Request(rid=100 + i, ids=r.ids,
+                                       max_new_tokens=MAX_NEW, seed=11 + i))
+    want = _single_engine_tokens(params, cfg, tok, serve, reqs)
+    router = FleetRouter(host_params, cfg, serve,
+                         FleetConfig(replicas=2, window_steps=4,
+                                     disagg_prefill=True,
+                                     prefill_pages=min_pages),
+                         eos_id=int(tok.eos_token_id))
+    replicas = list(router._replicas.values())
+    got = _tokens(router.run(list(reqs), max_wall_s=600))
+    assert got.keys() == want.keys()
+    for rid in want:
+        np.testing.assert_array_equal(got[rid], want[rid], err_msg=f"rid {rid}")
+    # pressure actually happened on the worker pool...
+    assert router.prefill.allocator.stats.reclaimed > 0
+    # ...and decode-side claims still fired (registered copies survive the
+    # worker's reclaims — the refcounted-reader invariant, pool-for-pool)
+    assert sum(e.allocator.stats.prefix_hits for e in replicas) > 0
+
+
+def test_claimed_pages_never_reclaimed_unit():
+    """Allocator-level spelling of the same invariant: a claimed
+    (refcount >= 1) registered page is not in the retained LRU, so pool
+    pressure can only reclaim unreferenced pages — a doomed allocation
+    returns None rather than stealing from a reader."""
+    alloc = PageAllocator(num_pages=6, page_size=4)
+    ids = tuple(range(8))
+    own = alloc.alloc(2)
+    alloc.register(ids, own)          # published prefix chain
+    alloc.claim(own)                  # a decode-side reader claims it
+    alloc.release(own)                # the writer lane evicts
+    # reader still holds refcount 1 -> pages are NOT retained/reclaimable
+    assert alloc.refcount[own[0]] == 1
+    got = alloc.alloc(4)              # pool has 3 free pages left
+    assert got is None                # refuses rather than stealing
+    assert alloc.lookup_prefix(ids, 2) == own  # registry intact
+    alloc.release(own)                # reader done -> retained now
+    assert alloc.alloc(4) is not None  # pressure may NOW reclaim them
+
+
+# ---------------------------------------------------------------------------
+# Autoscale: grow under load, drain when idle, parity throughout.
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_autoscale_up_and_down(tok, cfg, params, host_params):
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=8,
+                        window_steps=8)
+    burst = synthetic_request_stream(tok, 10, seed=7, max_new_tokens=8,
+                                     buckets=(8, 16))
+    # a trickle arrives after the burst drains: low occupancy, empty queue
+    trickle = [
+        Request(rid=100 + i, ids=burst[i].ids, max_new_tokens=8,
+                seed=7 + i, arrival_s=1.5 + 0.4 * i)
+        for i in range(4)
+    ]
+    reqs = burst + trickle
+    want = _single_engine_tokens(params, cfg, tok, serve, reqs)
+    router = FleetRouter(
+        host_params, cfg, serve,
+        FleetConfig(replicas=1, max_replicas=2, window_steps=2,
+                    scale_up_occupancy=0.9, scale_down_occupancy=0.45),
+        eos_id=int(tok.eos_token_id))
+    got = _tokens(router.run(list(reqs), max_wall_s=600))
+    for rid in want:
+        np.testing.assert_array_equal(got[rid], want[rid], err_msg=f"rid {rid}")
+    s = router.last_summary
+    assert s["scale_ups"] >= 1, s
+    assert s["scale_downs"] >= 1, s
+    assert s["replicas_peak"] == 2
+    assert s["duplicate_completions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: fleet JSONL + report render + the --min_fleet_tps gate.
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_jsonl_and_report_gate(tok, cfg, host_params, tmp_path):
+    import importlib
+
+    from tpukit.obs import FlightRecorder, StepLogger
+
+    report = importlib.import_module("tools.report")
+    log = tmp_path / "fleet.jsonl"
+    logger = StepLogger(str(log))
+    recorder = FlightRecorder(capacity=64)
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=8,
+                        window_steps=4)
+    reqs = synthetic_request_stream(tok, 8, seed=8, max_new_tokens=8,
+                                    buckets=(8, 16))
+    router = FleetRouter(host_params, cfg, serve,
+                         FleetConfig(replicas=2, window_steps=2,
+                                     kill_spec="replica_kill@1:1"),
+                         eos_id=int(tok.eos_token_id), logger=logger,
+                         recorder=recorder)
+    router.run(list(reqs), max_wall_s=300)
+    logger.close()
+
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    fleet_wins = [r for r in recs if r["kind"] == "fleet"]
+    fleet_sums = [r for r in recs if r["kind"] == "fleet_summary"]
+    events = [r for r in recs if r["kind"] == "fleet_event"]
+    serve_wins = [r for r in recs if r["kind"] == "serve"]
+    serve_sums = [r for r in recs if r["kind"] == "serve_summary"]
+    assert fleet_wins and len(fleet_sums) == 1
+    assert any(e["event"] == "replica_kill" for e in events)
+    # replica-tagged serve telemetry: every window/summary names its engine
+    assert serve_wins and all("replica" in r for r in serve_wins)
+    assert serve_sums and all("replica" in r for r in serve_sums)
+    s = fleet_sums[0]
+    assert s["requests"] == 8 and s["tokens_per_sec"] > 0
+    assert s["requeued"] >= 1 and s["duplicate_completions"] == 0
+    assert s["p99_e2e_s"] >= s["p50_e2e_s"]
+    # the flight recorder saw the fleet records too
+    ring = [r for r in recorder.snapshot() if r["kind"] == "fleet_summary"]
+    assert len(ring) == 1
+
+    text = report.summarize(recs)
+    assert "== fleet ==" in text
+    assert "fleet tokens/s" in text and "re-queued" in text
+    assert "per-replica occupancy" in text
+
+    ok, msg = report.check_min_fleet_tps(recs, 1.0)
+    assert ok, msg
+    ok, msg = report.check_min_fleet_tps(recs, 1e9)
+    assert not ok and "FAIL" in msg
+    # no fleet records at all -> fail, never a vacuous pass
+    ok, msg = report.check_min_fleet_tps(
+        [r for r in recs if r["kind"] != "fleet_summary"], 1.0)
+    assert not ok and "no fleet_summary" in msg
+    # an exactly-once violation fails the gate even above threshold
+    forged = [dict(s, duplicate_completions=1)]
+    ok, msg = report.check_min_fleet_tps(forged, 1.0)
+    assert not ok and "duplicate" in msg
+
+
+# ---------------------------------------------------------------------------
+# Validation: named construction errors, fleet-scoped chaos grammar.
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_config_validation(tok, cfg, host_params):
+    with pytest.raises(ValueError, match="replicas"):
+        FleetConfig(replicas=0)
+    with pytest.raises(ValueError, match="min_replicas"):
+        FleetConfig(replicas=2, min_replicas=3)
+    with pytest.raises(ValueError, match="max_replicas"):
+        FleetConfig(replicas=4, max_replicas=2)
+    with pytest.raises(ValueError, match="oscillate"):
+        FleetConfig(scale_up_occupancy=0.5, scale_down_occupancy=0.5)
+    with pytest.raises(ValueError, match="prefill worker"):
+        FleetConfig(prefill_slots=4)
+    with pytest.raises(chaos_lib.ChaosSpecError, match="replica_kill"):
+        FleetConfig(kill_spec="nan_loss@5")
+    with pytest.raises(chaos_lib.ChaosSpecError, match="integer replica id"):
+        chaos_lib.parse_spec("replica_kill@5:-1")
+    # the training harness rejects fleet-scoped faults by name
+    with pytest.raises(chaos_lib.ChaosSpecError, match="fleet-scoped"):
+        chaos_lib.ChaosEngine("replica_kill@5")
+    serve_ring = ServeConfig(slots=2, buckets=(8,), max_new_tokens=4)
+    with pytest.raises(ValueError, match="paged cache"):
+        FleetRouter(host_params, cfg, serve_ring,
+                    FleetConfig(replicas=2, disagg_prefill=True), eos_id=1)
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        FleetRouter(host_params, cfg, serve_ring,
+                    FleetConfig(replicas=2, devices_per_replica=8), eos_id=1)
+    moe = cfg.replace(num_experts=2, moe_dispatch="pallas")
+    with pytest.raises(ValueError, match="meshless"):
+        FleetRouter(host_params, moe, serve_ring,
+                    FleetConfig(replicas=2, devices_per_replica=2), eos_id=1)
+
+
+def test_fleet_decode_plan_is_standalone_plan():
+    """The router adds ZERO collectives: the per-replica plan is the
+    standalone decode closed form, byte for byte, on a subset mesh."""
+    from tpukit.analysis import decode_comm_plan, fleet_decode_comm_plan
+    from tpukit.mesh import create_mesh
+
+    cfg = GPTConfig(dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=160,
+                    max_position_embeddings=64, compute_dtype=jnp.float32)
+    mesh = create_mesh({"data": 1, "model": 4},
+                       devices=jax.devices()[4:8])
+    base = decode_comm_plan(cfg, mesh, 4)
+    fleet = fleet_decode_comm_plan(cfg, mesh, 4)
+    assert fleet.ops == base.ops and fleet.exhaustive
+    assert fleet.label.startswith("fleet replica")

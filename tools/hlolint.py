@@ -70,6 +70,11 @@ WORLDS = (
     # wire; the sidecar plan carries the overlap declaration so the
     # promoted `overlap` rule gates the async/bucket schedule offline
     "ddp_overlap", "fsdp_overlap", "ep_overlap",
+    # round 19 (fleet serving): the per-replica decode program compiled
+    # on a NON-LEADING device subset (a fleet replica's grid) — the
+    # router adds ZERO collectives, so the plan is the standalone decode
+    # closed form unchanged (analysis.plan.fleet_decode_comm_plan)
+    "fleet_decode",
 )
 
 # the golden-fixture subset checked into tests/fixtures/hlo/ (ISSUE 12);
@@ -176,7 +181,7 @@ def _decode_world(name: str, n_devices: int) -> dict:
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    from tpukit.analysis import decode_comm_plan
+    from tpukit.analysis import decode_comm_plan, fleet_decode_comm_plan
     from tpukit.mesh import create_mesh
     from tpukit.model import GPTConfig, init_params
     from tpukit.model import gpt
@@ -187,11 +192,26 @@ def _decode_world(name: str, n_devices: int) -> dict:
 
     paged = name == "paged_decode"
     spec = name == "spec_verify"
+    fleet = name == "fleet_decode"
     cfg = GPTConfig(
         dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=160,
         max_position_embeddings=64, compute_dtype=jnp.float32,
     )
-    mesh = create_mesh({"model": 4} if paged else {"data": 2, "model": 4})
+    if fleet:
+        # a fleet replica's grid: model-parallel over a NON-LEADING device
+        # subset (the second replica of a 2 x 4-device fleet) — same
+        # program, same plan, different devices; a router that leaked
+        # state into the compiled step would show up as surplus
+        # collectives or resharding here
+        devs = jax.devices()
+        if len(devs) < 8:
+            raise SystemExit(
+                "world fleet_decode needs 8 devices (it compiles on the "
+                "subset devices[4:8])"
+            )
+        mesh = create_mesh({"data": 1, "model": 4}, devices=devs[4:8])
+    else:
+        mesh = create_mesh({"model": 4} if paged else {"data": 2, "model": 4})
     slots, width, page, mp = 4, 24, 8, 3
     spec_k = 3  # the spec_verify world's draft width (verify window = 4)
     strat = TensorParallel(mesh)
@@ -242,12 +262,15 @@ def _decode_world(name: str, n_devices: int) -> dict:
                 params, cfg, buf, cache, cursors, active, limits, keys,
                 1, 0.0, 0, mesh,
             ).compile()
+    plan = (fleet_decode_comm_plan(cfg, mesh, slots, top_k=0)
+            if fleet else
+            decode_comm_plan(cfg, mesh, slots, top_k=0, paged=paged,
+                             verify_tokens=spec_k + 1 if spec else 1))
     return {
         "name": name,
         "text": compiled.as_text(),
         "stderr": cap["text"],
-        "plan": decode_comm_plan(cfg, mesh, slots, top_k=0, paged=paged,
-                                 verify_tokens=spec_k + 1 if spec else 1),
+        "plan": plan,
         # the serve jits deliberately do NOT donate (jaxlib deserialized-
         # executable mis-alias, serve/decode.py) — nothing to expect
         "expect_donated": None,
@@ -260,7 +283,7 @@ def build_world(name: str, n_devices: int) -> dict:
     {name, text, stderr, plan, expect_donated, comm_dtype}."""
     if name not in WORLDS:
         raise SystemExit(f"unknown world {name!r} — known: {', '.join(WORLDS)}")
-    if name in ("tp_decode", "paged_decode", "spec_verify"):
+    if name in ("tp_decode", "paged_decode", "spec_verify", "fleet_decode"):
         return _decode_world(name, n_devices)
     return _train_world(name, n_devices)
 

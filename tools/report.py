@@ -467,6 +467,74 @@ def summarize(records: list[dict]) -> str:
              f"{human_count(max(tps))}" if tps else "")
           + f"   queue depth last {serve_wins[-1].get('queue_depth', '?')}")
 
+    # round-19 fleet serving (tpukit/serve/fleet): the router's aggregate
+    # records plus the per-replica serve windows it tagged — fleet
+    # tokens/s, per-replica occupancy spread, fleet p50/p99 e2e latency
+    # (ROADMAP #1a), failure/requeue and autoscale accounting.
+    fleet_wins = _rows(records, "fleet")
+    fleet_sums = _rows(records, "fleet_summary")
+    fleet_events = _rows(records, "fleet_event")
+    if fleet_wins or fleet_sums:
+        w("== fleet ==")
+    for r in fleet_sums:
+        w(f"  {r.get('requests', '?')} requests over "
+          f"{r.get('replicas_final', '?')} replica(s) "
+          f"(peak {r.get('replicas_peak', '?')}): "
+          f"{human_count(r.get('tokens_per_sec'))} fleet tokens/s  "
+          f"({r.get('generated_tokens', '?')} tokens in "
+          f"{r.get('wall_s', 0):.2f}s)")
+        p50, p99 = r.get("p50_e2e_s"), r.get("p99_e2e_s")
+        if p50 is not None:
+            w(f"  fleet latency e2e p50/p99: "
+              f"{p50 * 1e3:.1f}/{p99 * 1e3:.1f} ms")
+        if r.get("kills") or r.get("requeued"):
+            dups = r.get("duplicate_completions", 0)
+            w(f"  failures: {r.get('kills', 0)} replica kill(s), "
+              f"{r.get('requeued', 0)} request(s) re-queued, "
+              f"{dups} duplicate completion(s)"
+              + ("" if not dups else "  <- EXACTLY-ONCE VIOLATED"))
+        if r.get("scale_ups") or r.get("scale_downs"):
+            w(f"  autoscale: {r.get('scale_ups', 0)} up / "
+              f"{r.get('scale_downs', 0)} down")
+        dp = r.get("disagg_prefill")
+        if isinstance(dp, dict):
+            w(f"  disaggregated prefill: {dp.get('handoffs', 0)} handoffs, "
+              f"{dp.get('worker_prefix_hits', 0)} worker prefix hits, "
+              f"{dp.get('worker_pages_reused', 0)} pages of prefill "
+              f"skipped")
+        if r.get("params_placements") is not None:
+            w(f"  cold start: {r['params_placements']} params placement(s) "
+              f"from one host copy")
+    # per-replica occupancy spread from the replica-tagged serve windows
+    # (each replica is a full engine emitting its own kind="serve" rows)
+    by_rep: dict = {}
+    for r in serve_wins:
+        if r.get("replica") is not None and r.get("occupancy") is not None:
+            by_rep.setdefault(r["replica"], []).append(r["occupancy"])
+    if by_rep and (fleet_wins or fleet_sums):
+        means = {k: sum(v) / len(v) for k, v in sorted(by_rep.items(),
+                                                       key=lambda kv: str(kv[0]))}
+        spread = (max(means.values()) - min(means.values())
+                  if len(means) > 1 else 0.0)
+        w("  per-replica occupancy: "
+          + "  ".join(f"r{k}={100 * m:.0f}%" for k, m in means.items())
+          + f"   spread {100 * spread:.0f}%")
+    if fleet_wins:
+        occ = [r["occupancy"] for r in fleet_wins
+               if r.get("occupancy") is not None]
+        tps = [r["tokens_per_sec"] for r in fleet_wins
+               if r.get("tokens_per_sec")]
+        w(f"  {len(fleet_wins)} fleet windows: occupancy mean "
+          f"{100 * sum(occ) / max(len(occ), 1):.0f}%"
+          + (f"   tokens/s last {human_count(tps[-1])} best "
+             f"{human_count(max(tps))}" if tps else "")
+          + f"   queue depth last {fleet_wins[-1].get('queue_depth', '?')}")
+    if fleet_events:
+        w(f"  events: " + ", ".join(
+            f"{r.get('event', '?')}"
+            + (f"(r{r['replica']})" if r.get("replica") is not None else "")
+            for r in fleet_events))
+
     cache_rows = _rows(records, "compile_cache")
     if cache_rows:
         w("== compile cache ==")
@@ -663,6 +731,53 @@ def summarize(records: list[dict]) -> str:
               + (f"   admit latency hit/cold {hit_s * 1e3:.1f}/"
                  f"{cold_s * 1e3:.1f} ms" if hit_s is not None
                  and cold_s is not None else ""))
+    # round-19 fleet bench (ROADMAP #1): the replica scaling curve at
+    # equal total devices + the disaggregated-prefill admit-latency
+    # comparison, with the CPU-loopback caveat carried in-record.
+    for r in records:
+        fs = r.get("fleet_serving")
+        if not isinstance(fs, dict):
+            continue
+        w("== fleet serving (bench, replicas at equal total devices) ==")
+        if "error" in fs:
+            w(f"  ERROR {fs['error']}")
+            continue
+        w(f"  stream: {fs.get('requests', '?')} requests, "
+          f"{fs.get('slots_per_replica', '?')} slots/replica, "
+          f"{fs.get('total_devices', '?')} total devices"
+          + ("" if fs.get("meshed") else " (meshless rungs)"))
+        for row in fs.get("rungs") or []:
+            if "error" in row:
+                w(f"  {row.get('replicas', '?')}x  ERROR {row['error']}")
+                continue
+            p99 = row.get("p99_e2e_s")
+            w(f"  {row['replicas']}x replicas "
+              f"({row.get('devices_per_replica', 0)} dev each): "
+              f"{human_count(row.get('tokens_per_sec'))} tokens/s"
+              + (f"   e2e p99 {p99 * 1e3:.1f} ms" if p99 is not None else "")
+              + (f"   admit {row['mean_admit_latency_s'] * 1e3:.1f} ms"
+                 if row.get("mean_admit_latency_s") is not None else ""))
+        sc = fs.get("scaling_2x_vs_1")
+        if sc is not None:
+            w(f"  headline: 2 replicas = {sc:.2f}x the 1-replica fleet "
+              f"tokens/s at equal total devices"
+              + ("" if sc > 1.5 else "  <- BELOW the 1.5x acceptance bar"))
+        w("  cross-rung token parity: "
+          + ("OK" if fs.get("parity_ok") else "<- MISMATCH"))
+        dp = fs.get("disagg_prefill")
+        if isinstance(dp, dict):
+            if "error" in dp:
+                w(f"  disagg prefill probe ERROR {dp['error']}")
+            else:
+                ca, da = (dp.get("colocated_admit_latency_s"),
+                          dp.get("disagg_admit_latency_s"))
+                w(f"  prefill: colocated admit "
+                  f"{(ca or 0) * 1e3:.1f} ms vs disaggregated "
+                  f"{(da or 0) * 1e3:.1f} ms   ({dp.get('handoffs', '?')} "
+                  f"handoffs, {dp.get('worker_prefix_hits', '?')} worker "
+                  f"prefix hits)")
+        if fs.get("caveat"):
+            w(f"  caveat: {fs['caveat']}")
     # round-11 dispatch ladder (ROADMAP #3): the three MoE dataflows side
     # by side at e8 top-1/top-2, MFU normalized by ACTIVE FLOPs (top_k
     # experts + router per token) so padding/dispatch waste reads as lost
@@ -742,6 +857,34 @@ def check_min_accept_rate(records: list[dict], threshold: float) -> tuple[bool, 
     )
 
 
+def check_min_fleet_tps(records: list[dict], threshold: float) -> tuple[bool, str]:
+    """Fleet-throughput CI gate (`--min_fleet_tps`, round 19): the run's
+    `kind="fleet_summary"` tokens/s must reach `threshold`, AND the
+    exactly-once invariant must hold (zero duplicate completions — a
+    killed replica's requests must re-queue, not double-emit). Returns
+    (ok, message) — a log without a fleet summary fails, so the gate
+    can't pass vacuously when someone drops `--replicas` from the smoke
+    invocation (the `--min_accept_rate` discipline)."""
+    sums = [r for r in _rows(records, "fleet_summary")
+            if r.get("tokens_per_sec") is not None]
+    if not sums:
+        return False, ("--min_fleet_tps: no fleet_summary record in the "
+                       "log (was the run --replicas'ed?)")
+    s = sums[-1]
+    tps = s["tokens_per_sec"]
+    dups = s.get("duplicate_completions", 0)
+    ok = tps >= threshold and not dups
+    verdict = "OK" if ok else "FAIL"
+    return ok, (
+        f"--min_fleet_tps {verdict}: {tps:.1f} fleet tokens/s over "
+        f"{s.get('replicas_peak', '?')} peak replica(s), "
+        f"{s.get('requeued', 0)} re-queued, {dups} duplicate completion(s) "
+        f"(threshold {threshold:.1f}"
+        + ("" if not dups else "; duplicates violate exactly-once")
+        + ")"
+    )
+
+
 def check_min_overlap_frac(records: list[dict], threshold: float) -> tuple[bool, str]:
     """Overlap-schedule gate (`--min_overlap_frac`, round 18): every
     bucketed rung of the bench `comm_overlap` record must have
@@ -811,6 +954,12 @@ def main(argv=None) -> int:
         "summary) — the draft-health regression gate for CI",
     )
     ap.add_argument(
+        "--min_fleet_tps", type=float, default=None, metavar="TOKENS_PER_SEC",
+        help="assert the fleet_summary tokens/s >= this with zero "
+        "duplicate completions (exit 2 below it, or when the log has no "
+        "fleet summary) — the fleet-serving regression gate for CI",
+    )
+    ap.add_argument(
         "--min_overlap_frac", type=float, default=None, metavar="FRACTION",
         help="assert every bucketed comm_overlap bench rung's "
         "overlap_frac (hlolint-measured hidden-wires fraction) >= "
@@ -834,6 +983,10 @@ def main(argv=None) -> int:
         rc = rc if ok else 2
     if args.min_accept_rate is not None:
         ok, msg = check_min_accept_rate(records, args.min_accept_rate)
+        print(msg, file=sys.stdout if ok else sys.stderr)
+        rc = rc if ok else 2
+    if args.min_fleet_tps is not None:
+        ok, msg = check_min_fleet_tps(records, args.min_fleet_tps)
         print(msg, file=sys.stdout if ok else sys.stderr)
         rc = rc if ok else 2
     if args.min_overlap_frac is not None:

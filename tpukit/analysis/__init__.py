@@ -27,6 +27,7 @@ from tpukit.analysis.hlo_ir import (  # noqa: F401
 from tpukit.analysis.plan import (  # noqa: F401
     CommPlan,
     decode_comm_plan,
+    fleet_decode_comm_plan,
     ring_wire_bytes,
     train_comm_plan,
 )

@@ -166,6 +166,24 @@ def decode_comm_plan(cfg, mesh, slots: int, top_k: int = 0,
     )
 
 
+def fleet_decode_comm_plan(cfg, mesh, slots: int, top_k: int = 0,
+                           paged: bool = False) -> CommPlan:
+    """Per-replica decode plan for the fleet router (round 19,
+    tpukit/serve/fleet.py): the router is pure host-side scheduling over
+    DISJOINT device subsets — it adds ZERO collectives — so each
+    replica's decode program must audit against exactly the standalone
+    engine's closed form (`decode_comm_plan`), merely compiled on a
+    subset mesh. A fleet whose per-replica HLO drifts from this plan has
+    leaked router state into the compiled program (e.g. a cross-replica
+    sharding constraint), which is precisely what the hlolint
+    `fleet_decode` world exists to catch: it compiles `decode_step` on a
+    NON-LEADING device subset of the 8-virtual-device mesh and requires
+    plan-exact collectives with 0 involuntary-remat warnings."""
+    p = decode_comm_plan(cfg, mesh, slots, top_k=top_k, paged=paged)
+    p.label = f"fleet replica {p.label}"
+    return p
+
+
 def ring_wire_bytes(collectives: dict[str, dict], world: int) -> int:
     """Estimated bytes each device actually moves over the interconnect
     for the parsed collectives, from their RESULT payloads (what

@@ -44,6 +44,15 @@ is recovered by the backoff wrapper, c > fails loud):
     ckpt_read_fail@K[:c]   checkpoint read path (restore)
     loader_io_fail@K[:c]   DataLoader batch fetch
 
+fleet-scoped kinds (round 19, tpukit/serve/fleet.py — the serving
+router's failure model, indexed by fleet DISPATCH ROUND, not training
+step; legal only in `FleetConfig.kill_spec` / `--fleet_kill`, and
+rejected by the training ChaosEngine with a named error so a misplaced
+entry fails at startup):
+    replica_kill@R[:idx]   at dispatch round R, drop replica idx (default:
+                           the highest live id) — its in-flight requests
+                           re-queue onto the surviving replicas
+
 Injection sites call the module-level hooks (`maybe_io_fault`), which are
 a single `is None` test when no harness is installed — chaos off costs
 one predictable branch per I/O call and NOTHING in the compiled step (all
@@ -62,6 +71,9 @@ STEP_KINDS = (
     "nan_loss", "spike_loss", "sigterm", "sigint", "hang", "bitflip", "resize",
 )
 IO_KINDS = ("ckpt_io_fail", "ckpt_read_fail", "loader_io_fail")
+# fleet-scoped kinds (round 19): parsed by the shared grammar, consumed by
+# serve/fleet.FleetRouter, REJECTED by the training ChaosEngine below
+FLEET_KINDS = ("replica_kill",)
 # io-site label (as used by the checkpoint/loader call sites) per kind
 _IO_SITE = {
     "ckpt_io_fail": "ckpt_write",
@@ -94,10 +106,11 @@ def parse_spec(spec: str) -> list[dict]:
                 f"chaos spec entry {raw!r} does not match kind@step[:param]"
             )
         kind = m.group("kind")
-        if kind not in STEP_KINDS + IO_KINDS + ("skip",):
+        known = STEP_KINDS + IO_KINDS + FLEET_KINDS + ("skip",)
+        if kind not in known:
             raise ChaosSpecError(
                 f"chaos spec entry {raw!r}: unknown kind {kind!r} "
-                f"(known: {', '.join(STEP_KINDS + IO_KINDS + ('skip',))})"
+                f"(known: {', '.join(known)})"
             )
         param = m.group("param")
         entry = {
@@ -123,6 +136,13 @@ def parse_spec(spec: str) -> list[dict]:
                 raise ChaosSpecError(
                     f"chaos spec entry {raw!r}: resize needs an integer "
                     f"target world size >= 1 (resize@N:M)"
+                )
+        if kind == "replica_kill" and entry["param"] is not None:
+            p = entry["param"]
+            if p != int(p) or int(p) < 0:
+                raise ChaosSpecError(
+                    f"chaos spec entry {raw!r}: replica_kill's optional "
+                    f"target must be an integer replica id >= 0"
                 )
         if kind in IO_KINDS:
             if entry["at"] < 1:
@@ -167,6 +187,15 @@ class ChaosEngine:
         # metadata records it as `resize_to`, what the relaunch asserts)
         self.resize_target: int | None = None
         for e in parse_spec(spec):
+            if e["kind"] in FLEET_KINDS:
+                # a fleet fault in a training spec would silently never
+                # fire (the trainer has no dispatch rounds) — the exact
+                # failure mode the fail-at-startup contract forbids
+                raise ChaosSpecError(
+                    f"chaos spec {e['kind']}@{e['at']}: fleet-scoped faults "
+                    f"belong to the serving router — pass them via "
+                    f"--fleet_kill / FleetConfig.kill_spec, not --chaos_spec"
+                )
             if e["kind"] == "bitflip" and e["param"] is not None and not (
                 0 <= int(e["param"]) < process_count
             ):

@@ -392,3 +392,50 @@ def add_serve_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "proposer matches (falls back through shorter "
                         "suffixes down to 1)")
     return parser
+
+
+def add_fleet_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """Fleet-router flags (round 19, tpukit/serve/fleet.py) — one spelling
+    shared by main-serve.py and any harness that builds a `FleetConfig`
+    from a CLI. `--replicas 0` (the default) keeps the single-engine
+    round-14/15 path byte-untouched; >= 1 routes the stream through a
+    FleetRouter over that many `ServeEngine` replicas, each on its own
+    device subset (`--devices_per_replica`). Validation lives on
+    FleetConfig, so misconfigurations fail with named errors at startup."""
+    parser.add_argument("--replicas", type=int, default=0,
+                        help="fleet mode: route the stream over this many "
+                        "engine replicas (0 = the single-engine path)")
+    parser.add_argument("--devices_per_replica", type=int, default=0,
+                        help="devices per replica subset (each replica "
+                        "grids its subset via pick_serve_grid); 0 = "
+                        "meshless replicas on the default device")
+    parser.add_argument("--min_replicas", type=int, default=1,
+                        help="autoscale floor (scale-down never goes below)")
+    parser.add_argument("--max_replicas", type=int, default=0,
+                        help="autoscale ceiling; 0 = --replicas (no "
+                        "scale-up headroom)")
+    parser.add_argument("--scale_up_occupancy", type=float, default=0.0,
+                        help="mean fleet slot occupancy above which a "
+                        "window triggers a scale-up (0 disables)")
+    parser.add_argument("--scale_down_occupancy", type=float, default=0.0,
+                        help="mean fleet slot occupancy below which an "
+                        "idle-queue window drains one replica (0 disables)")
+    parser.add_argument("--fleet_window_steps", type=int, default=16,
+                        help="fleet window cadence in dispatch rounds "
+                        "(drives kind=\"fleet\" records AND the autoscale "
+                        "check)")
+    parser.add_argument("--disagg_prefill", action="store_true",
+                        help="disaggregated prefill: a dedicated worker "
+                        "runs chunked prefill and hands finished prefixes "
+                        "to decode replicas as pages (requires --page_size)")
+    parser.add_argument("--prefill_slots", type=int, default=0,
+                        help="prefill worker lanes (0 = --slots)")
+    parser.add_argument("--prefill_pages", type=int, default=0,
+                        help="prefill worker pool pages (0 = the "
+                        "--num_pages default)")
+    parser.add_argument("--fleet_kill", type=str, default="",
+                        help="deterministic replica failure: "
+                        "replica_kill@R[:idx] chaos grammar — at dispatch "
+                        "round R drop that replica; its in-flight requests "
+                        "re-queue onto survivors")
+    return parser

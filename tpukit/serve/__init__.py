@@ -7,8 +7,11 @@ stream in `engine.py`; the paged KV cache — page pool + block tables,
 shared-prefix registry, chunked prefill, int8 page payloads (round 15,
 ROADMAP #2) — in `paged.py`; speculative decoding — draft-and-verify
 with distribution-exact rejection sampling, self-speculation and draft-
-model proposers (round 17, ROADMAP #3) — in `spec.py`.
-Recipe: `main-serve.py`.
+model proposers (round 17, ROADMAP #3) — in `spec.py`; fleet serving —
+a request router over N replica engines on disjoint device subsets,
+disaggregated prefill via paged-KV handoff, occupancy autoscale,
+chaos kill with exactly-once requeue (round 19, ROADMAP #1) — in
+`fleet.py`. Recipe: `main-serve.py`.
 """
 
 from tpukit.serve import paged, spec  # noqa: F401
@@ -26,4 +29,9 @@ from tpukit.serve.engine import (  # noqa: F401
     ServeConfig,
     ServeEngine,
     synthetic_request_stream,
+)
+from tpukit.serve.fleet import (  # noqa: F401
+    FleetConfig,
+    FleetRouter,
+    pick_serve_grid,
 )

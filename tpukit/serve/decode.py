@@ -280,6 +280,30 @@ def prefill_chunk_paged(params, cfg: gpt.GPTConfig, buf, cache, cursors,
     return buf, cache, cursors, active, limits, keys
 
 
+# No donation — see the decode_step note (persistent-cache deserialization
+# of donated executables mis-aliases on this jaxlib).
+@jax.jit
+def adopt_slot(buf, cursors, active, limits, keys, slot, row, prompt_len,
+               new_limit, new_key):
+    """Arm ONE lane whose K/V was prefilled by a DIFFERENT engine (the
+    disaggregated-prefill handoff, round 19, tpukit/serve/fleet.py): write
+    the prompt row into the token buffer at `slot` and set the lane's
+    decode state — cursor to `prompt_len`, limit, per-request key, active.
+    Pure dynamic-update-slice/at-set writes, NO model forward: the page
+    pool already holds the handed-off K/V (copied by fleet._copy_pages),
+    so a decode replica adopting prefixes never compiles a prefill
+    program — its serve-path compile budget is one decode program plus
+    this trivial arm (one compile per (slots, width) shape)."""
+    buf = jax.lax.dynamic_update_slice(
+        buf, row[None].astype(buf.dtype), (slot, 0)
+    )
+    cursors = cursors.at[slot].set(prompt_len)
+    active = active.at[slot].set(True)
+    limits = limits.at[slot].set(new_limit)
+    keys = keys.at[slot].set(new_key)
+    return buf, cursors, active, limits, keys
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "eos_id", "temperature", "top_k"),

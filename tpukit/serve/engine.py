@@ -352,7 +352,7 @@ class ServeEngine:
 
     def __init__(self, params, cfg: gpt.GPTConfig, serve: ServeConfig,
                  eos_id: int, mesh=None, logger=None, recorder=None,
-                 draft_params=None, draft_cfg=None):
+                 draft_params=None, draft_cfg=None, replica=None):
         if serve.kv_width > cfg.max_position_embeddings:
             raise ValueError(
                 f"KV ring width {serve.kv_width} (max bucket "
@@ -407,6 +407,11 @@ class ServeEngine:
         self.mesh = mesh
         self.logger = logger
         self.recorder = recorder
+        # Fleet identity (round 19, tpukit/serve/fleet.py): stamped on
+        # every serve window/summary this engine emits so the fleet report
+        # can aggregate per-replica telemetry. None = standalone engine,
+        # records unchanged.
+        self.replica = replica
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         # lax.top_k rejects k beyond the logits width — clamp like generate()
@@ -460,6 +465,10 @@ class ServeEngine:
             place = lambda x, spec: jnp.asarray(x)
             cache_spec = pool_spec = scale_spec = slot_spec = P()
         self._place = place
+        # kept for the fleet page handoff: a copied page block lands at the
+        # destination pool's layout (fleet._copy_pages, round 19)
+        self._pool_spec = pool_spec
+        self._scale_spec = scale_spec
 
         self.buf = place(np.zeros((n, w), np.int32), P(*slot_spec, None))
         if serve.paged:
@@ -939,6 +948,8 @@ class ServeEngine:
                     h - h0 for h, h0 in zip(self.spec_hist, self._win["hist0"])
                 ],
             )
+        if self.replica is not None:
+            rec["replica"] = self.replica
         if self.logger is not None:
             self.logger.log(**rec)
         if self.recorder is not None:
@@ -978,6 +989,8 @@ class ServeEngine:
             p50_token_s=_pct([c.per_token_s for c in comps], 50),
             p99_token_s=_pct([c.per_token_s for c in comps], 99),
         )
+        if self.replica is not None:
+            rec["replica"] = self.replica
         ep = self.spans.epoch()
         rec["prefill_s"] = ep["seconds"].get("prefill", 0.0)
         rec["decode_s"] = ep["seconds"].get("decode", 0.0)
@@ -1013,6 +1026,181 @@ class ServeEngine:
             )
         return rec
 
+    # ---- step primitives (the fleet hooks, round 19) ---------------------
+    # `run()` below is spelled entirely in terms of these, so a FleetRouter
+    # (tpukit/serve/fleet.py) driving N engines round-robin exercises the
+    # exact scheduling code the standalone loop does — the token-parity
+    # guarantee transfers instead of being re-proven.
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def live_lanes(self) -> int:
+        return len(self._lanes)
+
+    @property
+    def decoding_lanes(self) -> int:
+        return sum(1 for l in self._lanes.values() if l.phase == "decode")
+
+    @property
+    def generated_tokens(self) -> int:
+        """Tokens generated so far (completed + live lanes, as of the last
+        sync) — the fleet router's aggregation counter."""
+        return self._gen_total
+
+    @property
+    def free_pages(self) -> int:
+        """Pages an admission could obtain (free + reclaimable retained);
+        the ring has no page budget, so it reports effectively-infinite —
+        the router's least-loaded tiebreak never binds on it."""
+        return self.allocator.available_pages if self.serve.paged else (1 << 30)
+
+    def admit(self, reqs: list[Request], now: float) -> list[Request]:
+        """Admit as many of `reqs` (in order) as capacity allows; returns
+        the un-admitted tail. Ring: up to the free-slot count in ONE
+        batched bucket-grouped prefill. Paged: head-of-line page-aware
+        admission — stops at the first request the pool cannot cover
+        (FIFO, no starvation), exactly the run-loop semantics."""
+        if not self.serve.paged:
+            take = reqs[: len(self._free)]
+            if take:
+                self._admit_batch(take, now)
+            return list(reqs[len(take):])
+        left = list(reqs)
+        while left and self._free:
+            if not self._admit_paged_one(left[0], now):
+                break
+            left.pop(0)
+        return left
+
+    def poll_prefill(self, now: float) -> None:
+        """Advance every prefilling paged lane one chunk (no-op on the
+        ring, whose prefill is one-shot at admission)."""
+        if self.serve.paged:
+            self._dispatch_prefill_chunks(now)
+
+    def dispatch_decode(self) -> bool:
+        """Dispatch one decode quantum (or spec draft-and-verify quantum)
+        if any lane is decoding; returns whether anything was dispatched.
+        The dispatch is async — callers overlap several engines' quanta by
+        dispatching all of them before the first `sync`."""
+        if not any(l.phase == "decode" for l in self._lanes.values()):
+            return False
+        if self.serve.draft:
+            self._spec_step()
+        else:
+            self._step()
+        return True
+
+    def sync(self, now: float) -> None:
+        """The per-quantum host sync: fetch cursors/flags, retire finished
+        lanes, and emit a `kind="serve"` window when one is due."""
+        self._sync_evict(now)
+        if self._win["steps"] >= self.serve.window_steps:
+            self._emit_window()
+
+    def finish(self, wall_s: float) -> list[Completion]:
+        """Flush the partial window and emit the `kind="serve_summary"`
+        record; returns the completions. The run loop's epilogue, exposed
+        so the fleet can finalize each replica at fleet shutdown."""
+        if self._win["steps"]:
+            self._emit_window()
+        rec = self.last_summary = self.summary(wall_s)
+        if self.logger is not None:
+            self.logger.log(**rec)
+        if self.recorder is not None:
+            self.recorder.record(
+                "serve_summary", requests=rec["requests"],
+                tokens_per_sec=rec["tokens_per_sec"],
+                mean_occupancy=rec["mean_occupancy"],
+            )
+        return self.completions
+
+    def requeue_live(self) -> list[Request]:
+        """The in-flight requests of this replica, reconstructed from the
+        Request objects themselves — the completion-carries-prompt
+        invariant (round 15) means a lane's original prompt never depends
+        on device state, so a chaos-killed replica's work re-queues onto
+        survivors losslessly: same prompt, same per-request seed, hence
+        (engine parity) the same tokens. Partial output is discarded, so
+        each request's tokens are emitted exactly once, by whichever
+        replica finishes it. Does not mutate the engine — a killed
+        replica is simply dropped."""
+        return sorted((l.req for l in self._lanes.values()),
+                      key=lambda r: r.rid)
+
+    # ---- disaggregated prefill (round 19, tpukit/serve/fleet.py) ---------
+
+    def release_lane(self, slot: int) -> None:
+        """Retire lane `slot` WITHOUT a completion — the prefill worker's
+        half of the page handoff: once a finished prefix is copied to a
+        decode replica, the worker drops its references (registered lead
+        pages retire into the prefix LRU for future hits, private pages
+        free) and zeroes the block-table row so any stale in-flight write
+        lands in the null page (write-safety invariant 2)."""
+        lane = self._lanes.pop(slot)
+        if self.serve.paged:
+            self.allocator.release(lane.pages)
+            self._bt[slot] = 0
+            self._bt_dirty = True
+        self._free.append(slot)
+
+    def adopt_prefilled(self, req: Request, pages: list[int], shared: int,
+                        admit_s: float, now: float, key) -> int:
+        """Decode-replica half of the disaggregated handoff: arm a lane
+        whose K/V pages were prefilled ELSEWHERE (already copied into this
+        engine's pool at `pages` by fleet._copy_pages) — the replica never
+        runs a prefill program, so its serve-path compile budget is one
+        decode program plus this (dynamic-update-slice-only) arm.
+
+        `pages` must already be allocated/claimed on THIS engine's
+        allocator (`shared` = how many lead pages are decode-side registry
+        claims); the block-table row, buffer row (the full prompt — the
+        first decode tick re-forwards position prompt_len-1) and per-slot
+        decode state are armed here. Registers the lead
+        `(prompt_len-1)//P` pages so later handoffs of the same prefix
+        claim them instead of re-copying (write-safety invariant 1: the
+        last prompt position's page stays private)."""
+        if not self.serve.paged:
+            raise ValueError(
+                "adopt_prefilled requires the paged cache (page_size > 0) "
+                "— the disaggregated handoff rides page granularity"
+            )
+        plen = len(req.ids)
+        slot = self._free.popleft()
+        self._bt[slot] = 0
+        self._bt[slot, : len(pages)] = pages
+        self._bt_dirty = True
+        self._refresh_bt()
+        row = np.zeros((self.serve.padded_width,), np.int32)
+        row[:plen] = req.ids
+        limit = min(plen + req.max_new_tokens, self.serve.width)
+        key = np.asarray(key, np.uint32)
+        (self.buf, self.cursors, self.active, self.limits,
+         self.keys) = serve_decode.adopt_slot(
+            self.buf, self.cursors, self.active, self.limits, self.keys,
+            self._place(np.asarray(slot, np.int32), P()),
+            self._place(row, P()),
+            self._place(np.asarray(plen, np.int32), P()),
+            self._place(np.asarray(limit, np.int32), P()),
+            self._place(key, P()),
+        )
+        reg = (plen - 1) // self.serve.page_size
+        self.allocator.register(req.ids, pages[:reg])
+        self._lanes[slot] = _Lane(
+            req, admit_s, plen, self.bucket_for(plen), pages=list(pages),
+            shared=shared, next_chunk=0, prefill_end=0, phase="decode",
+            active_s=now, key=key,
+        )
+        self.admitted += 1
+        self.max_live = max(self.max_live, len(self._lanes))
+        if shared:
+            self.allocator.stats.prefix_hits += 1
+            self.allocator.stats.prefix_pages_reused += shared
+        return slot
+
     # ---- the loop --------------------------------------------------------
 
     def run(self, requests, max_wall_s: float | None = None) -> list[Completion]:
@@ -1033,50 +1221,25 @@ class ServeEngine:
                     f"serve run exceeded max_wall_s={max_wall_s} with "
                     f"{len(self._pending)} pending / {len(self._lanes)} live"
                 )
-            if self.serve.paged:
-                # page-aware admission control: a request needs a free lane
-                # AND its worst-case page footprint; the head of the queue
-                # waits (FIFO, no starvation) when the pool can't cover it
-                while (self._pending and self._free
-                       and self._pending[0].arrival_s <= now):
-                    if not self._admit_paged_one(self._pending[0], now):
-                        break
-                    self._pending.popleft()
-                self._dispatch_prefill_chunks(time.perf_counter() - t0)
-            else:
-                ready: list[Request] = []
-                while (self._pending and len(ready) < len(self._free)
-                       and self._pending[0].arrival_s <= now):
-                    ready.append(self._pending.popleft())
-                if ready:
-                    self._admit_batch(ready, now)
-            if not any(l.phase == "decode" for l in self._lanes.values()):
+            # page-aware admission control (paged): a request needs a free
+            # lane AND its worst-case page footprint; the head of the queue
+            # waits (FIFO, no starvation) when the pool can't cover it
+            ready: list[Request] = []
+            while (self._pending and len(ready) < len(self._free)
+                   and self._pending[0].arrival_s <= now):
+                ready.append(self._pending.popleft())
+            for req in reversed(self.admit(ready, now)):
+                self._pending.appendleft(req)
+            self.poll_prefill(time.perf_counter() - t0)
+            if not self.dispatch_decode():
                 if not self._lanes and self._pending:
                     # nothing decoding and the next arrival is in the future
                     wait = self._pending[0].arrival_s - now
                     if wait > 0:
                         time.sleep(min(wait, 0.05))
                 continue
-            if self.serve.draft:
-                self._spec_step()
-            else:
-                self._step()
-            self._sync_evict(time.perf_counter() - t0)
-            if self._win["steps"] >= self.serve.window_steps:
-                self._emit_window()
-        if self._win["steps"]:
-            self._emit_window()
-        wall = time.perf_counter() - t0
-        rec = self.last_summary = self.summary(wall)
-        if self.logger is not None:
-            self.logger.log(**rec)
-        if self.recorder is not None:
-            self.recorder.record(
-                "serve_summary", requests=rec["requests"],
-                tokens_per_sec=rec["tokens_per_sec"],
-                mean_occupancy=rec["mean_occupancy"],
-            )
-        return self.completions
+            self.sync(time.perf_counter() - t0)
+        return self.finish(time.perf_counter() - t0)
 
 
 STREAM_PROFILES = ("uniform", "repetitive", "shared_prefix")

@@ -232,6 +232,34 @@ def write_pages(pool, scales, bt, start, vals, write_mask):
     )
 
 
+# -- page handoff (round 19, disaggregated prefill) --------------------------
+# The ONE spelling of the device-to-device page copy the fleet's
+# prefill->decode handoff rides (tpukit/serve/fleet.py): extract gathers the
+# source pool's page rows (every layer, every head) into a dense block, the
+# caller moves the block between the two engines' device subsets with ONE
+# jax.device_put at the destination pool's layout, and insert scatters it
+# into the destination pool. Works on K/V pools ([L, NP, H, P, D]) AND int8
+# scale sidecars ([L, NP, H, blocks]) — anything with the page axis at
+# position 1. `ids` is traced, so the compile count is one per padded id
+# width (the caller pads to powers of two: src pads by repeating the last id
+# — re-extracting a page is idempotent — and dst pads with 0, the null-page
+# sink, write-safety invariant 2).
+
+
+@jax.jit
+def extract_pages(pool, ids):
+    """`pool[:, ids]` — the page rows to hand off, `[L, n, ...]`."""
+    return pool[:, ids]
+
+
+@jax.jit
+def insert_pages(pool, ids, block):
+    """Scatter a handed-off block into `pool` at page rows `ids`. The
+    destination pages are freshly allocated (exclusively owned, refcount
+    1) or the null page (pad), so rows never collide with a reader."""
+    return pool.at[:, ids].set(block.astype(pool.dtype))
+
+
 # -- host-side page allocator + shared-prefix registry ----------------------
 
 

@@ -44,7 +44,11 @@ bar), acceptance rate, and the appended-tokens/verify histogram. The
 total devices — fleet tokens/s scaling (>1.5x at 2 replicas is the bar),
 p99 under load, per-request token parity across rungs, and
 disaggregated-vs-colocated prefill admit latency — with an honest
-CPU-loopback caveat in-record.
+CPU-loopback caveat in-record. Round 20 adds the
+`serve_dispatch_attribution` record (per-quantum dispatch-vs-device wall
+split from the request tracer's quantum spans) and a `serving` rung
+inside `obs_overhead` (the trace recorder on vs off on the same seeded
+stream: tokens/s delta under the 1% bar, bit-identical output tokens).
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -564,6 +568,130 @@ def bench_serving(cfg, n_dev, requests=32, slots=8, max_new=16):
         "speedup_vs_cached": round(
             cont["tokens_per_sec"] / ser_cached["tokens_per_sec"], 2
         ) if ser_cached["tokens_per_sec"] else None,
+    }
+
+
+def bench_serve_trace_overhead(cfg, n_dev, requests=32, slots=8, max_new=16):
+    """Request-trace recorder overhead on the serving engine (round 20):
+    the SAME seeded stream served twice, tracer off then on, after a warm
+    pass that absorbs compiles. The tracer is host-side only — a dict +
+    deque append per span event — so the acceptance bar is a tokens/s
+    delta under 1% AND bit-identical output tokens per request (the
+    recorder observes, it never schedules). Also reports the event count
+    and ring drops so capacity sizing stays honest."""
+    import time
+
+    import jax
+
+    from tpukit.data import get_tokenizer
+    from tpukit.model import init_params
+    from tpukit.obs import TraceRecorder
+    from tpukit.serve import ServeConfig, ServeEngine, synthetic_request_stream
+
+    tokenizer = get_tokenizer()
+    tokenizer.pad_token_id = 2
+    cfg = cfg.replace(vocab_size=tokenizer.vocab_size)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    buckets = lengths = (8, 16, 24, 32)
+    eos = int(tokenizer.eos_token_id)
+    stream = list(synthetic_request_stream(
+        tokenizer, requests, seed=0, max_new_tokens=max_new,
+        buckets=buckets, lengths=lengths,
+    ))
+    serve = ServeConfig(slots=slots, buckets=buckets, max_new_tokens=max_new,
+                        window_steps=10**9)
+
+    def run(traced: bool):
+        tracer = TraceRecorder() if traced else None
+        eng = ServeEngine(params, cfg, serve, eos_id=eos, tracer=tracer)
+        t0 = time.perf_counter()
+        comps = eng.run(list(stream), max_wall_s=900)
+        wall = time.perf_counter() - t0
+        gen = sum(c.generated for c in comps)
+        toks = {c.rid: [int(x) for x in np.asarray(c.ids)] for c in comps}
+        return gen / wall, toks, tracer
+
+    run(False)  # warm: bucket prefills + the decode step compile
+    tps_off, toks_off, _ = run(False)
+    tps_on, toks_on, tracer = run(True)
+    return {
+        "requests": requests, "slots": slots, "max_new_tokens": max_new,
+        "tokens_per_sec_off": round(tps_off, 1),
+        "tokens_per_sec_on": round(tps_on, 1),
+        "overhead_frac": round((tps_off - tps_on) / tps_off, 4)
+        if tps_off else None,
+        "tokens_bit_identical": toks_off == toks_on,
+        "events_emitted": tracer.total_emitted,
+        "events_dropped": tracer.dropped,
+    }
+
+
+def bench_serve_dispatch_attribution(cfg, n_dev, requests=32, slots=8,
+                                     max_new=16):
+    """Per-quantum dispatch-vs-device attribution on a traced serving run
+    (round 20): where does a decode quantum's wall actually go — the
+    host-side async-dispatch loop (`dispatch_overhead_s`, the [t0,t1]
+    walls of the trace's quantum events) or waiting for the device at the
+    per-quantum sync (`device_s`, the [s0,s1] walls)? Derived from spans
+    the engine times anyway, so the record costs nothing beyond the
+    traced run itself. On CPU loopback the "device" is the host too, so
+    the split reads as loop-vs-XLA-compute; the per-quantum means are the
+    transferable numbers."""
+    import time
+
+    import jax
+
+    from tpukit.data import get_tokenizer
+    from tpukit.model import init_params
+    from tpukit.obs import TraceRecorder, build_trees, completeness
+    from tpukit.serve import ServeConfig, ServeEngine, synthetic_request_stream
+
+    tokenizer = get_tokenizer()
+    tokenizer.pad_token_id = 2
+    cfg = cfg.replace(vocab_size=tokenizer.vocab_size)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    buckets = lengths = (8, 16, 24, 32)
+    eos = int(tokenizer.eos_token_id)
+    stream = list(synthetic_request_stream(
+        tokenizer, requests, seed=0, max_new_tokens=max_new,
+        buckets=buckets, lengths=lengths,
+    ))
+    serve = ServeConfig(slots=slots, buckets=buckets, max_new_tokens=max_new,
+                        window_steps=10**9)
+
+    def run():
+        tracer = TraceRecorder()
+        eng = ServeEngine(params, cfg, serve, eos_id=eos, tracer=tracer)
+        t0 = time.perf_counter()
+        comps = eng.run(list(stream), max_wall_s=900)
+        wall = time.perf_counter() - t0
+        return eng, tracer, comps, wall
+
+    run()  # warm: absorbs compiles so the split reflects steady state
+    eng, tracer, comps, wall = run()
+    s = eng.last_summary or {}
+    quanta = [e for e in tracer.snapshot() if e.get("ev") == "quantum"]
+    disp = sum(q["t1"] - q["t0"] for q in quanta)
+    dev = sum(q["s1"] - q["s0"] for q in quanta if "s1" in q)
+    tot = disp + dev
+    trees = build_trees(tracer.snapshot())
+    return {
+        "requests": requests, "slots": slots, "max_new_tokens": max_new,
+        "decode_quantum": serve.decode_quantum,
+        "quanta": len(quanta),
+        "wall_s": round(wall, 3),
+        "dispatch_overhead_s": round(disp, 4),
+        "device_s": round(dev, 4),
+        "dispatch_frac": round(disp / tot, 4) if tot else None,
+        "mean_dispatch_ms_per_quantum": round(1e3 * disp / len(quanta), 3)
+        if quanta else None,
+        "mean_device_ms_per_quantum": round(1e3 * dev / len(quanta), 3)
+        if quanta else None,
+        # the summary's span-derived split must agree with the trace's
+        "summary_dispatch_overhead_s": round(s.get("dispatch_overhead_s", 0.0), 4),
+        "summary_device_s": round(s.get("device_s", 0.0), 4),
+        "trace_complete": completeness(trees),
+        "completed": len(comps),
     }
 
 
@@ -1541,6 +1669,17 @@ def main(argv=None):
         spec_decode_rec = {"error": repr(exc)}
         print(f"spec decode probe failed: {exc!r}", file=sys.stderr)
 
+    # Dispatch-vs-device attribution (round 20): where a decode quantum's
+    # wall goes — host async-dispatch loop vs waiting at the per-quantum
+    # sync — from the request tracer's quantum spans on a traced run.
+    serve_dispatch_rec = None
+    try:
+        serve_dispatch_rec = bench_serve_dispatch_attribution(cfg, n_dev)
+    except Exception as exc:
+        serve_dispatch_rec = {"error": repr(exc)}
+        print(f"serve dispatch attribution probe failed: {exc!r}",
+              file=sys.stderr)
+
     # Fleet serving (round 19, ROADMAP #1): 1 vs 2 vs 4 replicas on the
     # same stream at equal total devices — fleet tokens/s scaling (>1.5x
     # at 2 replicas is the bar), p99 under load, per-request parity, and
@@ -1569,6 +1708,18 @@ def main(argv=None):
     except Exception as exc:
         obs_overhead_err = repr(exc)
         print(f"obs overhead probe failed: {exc!r}", file=sys.stderr)
+
+    # Round-20 serving rung of the obs-overhead story: the request-trace
+    # recorder on vs off on the same seeded stream — tokens/s delta
+    # (<1% bar) and bit-identical output tokens.
+    try:
+        serving_rung = bench_serve_trace_overhead(cfg, n_dev)
+    except Exception as exc:
+        serving_rung = {"error": repr(exc)}
+        print(f"serve trace overhead probe failed: {exc!r}", file=sys.stderr)
+    if obs_overhead is None:
+        obs_overhead = {}
+    obs_overhead["serving"] = serving_rung
 
     # Ladder rungs (VERDICT r4 #1): single-chip measurements of the
     # BASELINE configs 2-5 shapes at head_dim=64 — GPT-small/medium full,
@@ -1611,6 +1762,7 @@ def main(argv=None):
         "serving": serving_rec,
         "paged_kv": paged_kv_rec,
         "spec_decode": spec_decode_rec,
+        "serve_dispatch_attribution": serve_dispatch_rec,
         "fleet_serving": fleet_serving_rec,
         "host_pipeline": host_pipeline,
         "host_pipeline_error": host_pipeline_err,

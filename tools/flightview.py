@@ -8,6 +8,9 @@ heartbeat snapshot across processes, in-flight async-checkpoint/prefetch
 state, and the run config. This tool turns that JSON into the page an
 operator actually reads at 3am: what fired, what every thread was doing,
 what the trainer did in the minutes before, and which process looks wrong.
+Serving-era rings (round 20) get their own headline — serve/fleet window
+records, fleet events and summaries — before the raw ring tail; the
+per-request story lives in the metrics JSONL (tools/traceview.py).
 
 Like tools/report.py it needs NOTHING but the file — no jax import — so it
 runs anywhere the bundle was copied to.
@@ -128,6 +131,46 @@ def render(bundle: dict, ring_tail: int = 25, full_stacks: bool = False) -> str:
                   f"({r.get('steps_lost', '?')} steps lost)")
             elif r["kind"] == "preempt":
                 w(f"  preempt {r.get('signal', '?')} at step {r.get('step', '?')}")
+
+    # Round-20 serving observability: a bundle dumped mid-serve (or
+    # post-kill) carries the engine/router ring records — headline them
+    # like the recovery events so the serving shape of the run (windows,
+    # occupancy, fleet kills/scales) reads before the raw ring tail.
+    serve_ring = [
+        r for r in (bundle.get("ring") or [])
+        if r.get("kind") in ("serve", "serve_summary", "fleet",
+                             "fleet_event", "fleet_summary")
+    ]
+    if serve_ring:
+        w("== serving events (from the ring) ==")
+        counts = {}
+        for r in serve_ring:
+            counts[r["kind"]] = counts.get(r["kind"], 0) + 1
+        w("  " + "  ".join(f"{k} x{v}" for k, v in sorted(counts.items())))
+        wins = [r for r in serve_ring if r["kind"] in ("serve", "fleet")]
+        if wins:
+            last = wins[-1]
+            occ = last.get("occupancy")
+            w(f"  last {last['kind']} window #{last.get('window', '?')}: "
+              f"{last.get('new_tokens', '?')} tokens"
+              + (f", occupancy {100 * occ:.0f}%" if occ is not None else "")
+              + (f", {last['replicas']} replica(s)"
+                 if last.get("replicas") is not None else ""))
+        for r in serve_ring:
+            if r["kind"] == "fleet_event":
+                extra = " ".join(f"{k}={v}" for k, v in r.items()
+                                 if k not in ("kind", "t", "event"))
+                w(f"  fleet_event {r.get('event', '?')}"
+                  + (f" ({extra})" if extra else ""))
+            elif r["kind"] == "serve_summary":
+                w(f"  serve_summary: {r.get('requests', '?')} requests, "
+                  f"{r.get('tokens_per_sec', 0):.1f} tokens/s, occupancy "
+                  f"{100 * (r.get('mean_occupancy') or 0):.0f}%")
+            elif r["kind"] == "fleet_summary":
+                w(f"  fleet_summary: {r.get('requests', '?')} requests, "
+                  f"{r.get('tokens_per_sec', 0):.1f} tokens/s, "
+                  f"{r.get('requeued', 0)} requeued / {r.get('kills', 0)} "
+                  f"kill(s)")
 
     stacks = bundle.get("stacks") or {}
     if stacks:

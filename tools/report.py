@@ -38,12 +38,17 @@ batching vs serial per-request decode on the same stream), and the
 `--min_serve_tps` CI gate. Round-17 speculative decoding adds the spec
 block on serve windows/summaries (acceptance rate, accepted-tokens
 histogram, draft/verify wall split) and the `--min_accept_rate` gate.
+Round-20 request tracing adds "trace_event"/"trace" rows (raw span events
+and per-request span trees — rendered in depth by tools/traceview.py),
+per-phase p50/p99 + dispatch-vs-device attribution on serve/fleet
+summaries, and the `--min_trace_complete` completeness-invariant gate.
 This tool needs NOTHING but
 the file — no jax import, so it runs anywhere the log was copied to.
 
 Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
                                         [--min_serve_tps 100]
                                         [--min_accept_rate 0.3]
+                                        [--min_trace_complete 1.0]
 """
 
 from __future__ import annotations
@@ -98,6 +103,27 @@ def _fmt_fractions(frac: dict) -> str:
         for k, v in sorted(frac.items(), key=lambda kv: -kv[1])
         if v >= 0.005
     )
+
+
+def _phase_lines(r: dict) -> list[str]:
+    """Round-20 request-trace rows on a serve_summary / fleet_summary:
+    per-phase p50/p99 walls and the span-tree completeness fraction."""
+    out = []
+    p50, p99 = r.get("phase_p50"), r.get("phase_p99")
+    if isinstance(p50, dict) and isinstance(p99, dict):
+        cells = [
+            f"{ph} {1e3 * p50[ph]:.1f}/{1e3 * p99[ph]:.1f}"
+            for ph in ("queue_wait", "prefill", "handoff", "decode",
+                       "sync_stall", "other")
+            if p50.get(ph) is not None
+        ]
+        if cells:
+            out.append("  request phases p50/p99 (ms): " + "  ".join(cells))
+    comp = r.get("trace_complete")
+    if comp is not None:
+        out.append(f"  traces: {100 * comp:.0f}% complete span trees"
+                   + ("" if comp >= 1.0 else "  <- INCOMPLETE TREES"))
+    return out
 
 
 def summarize(records: list[dict]) -> str:
@@ -425,8 +451,21 @@ def summarize(records: list[dict]) -> str:
           f"{r.get('decode_steps', '?')} decode steps over "
           f"{r.get('wall_s', 0):.2f}s  (prefill {r.get('prefill_s', 0):.2f}s"
           f" / decode {r.get('decode_s', 0):.2f}s"
-          f" / sync {r.get('sync_s', 0):.2f}s)   evicted: "
+          f" / sync {r.get('sync_s', 0):.2f}s"
+          + (f" / other {r['other_s']:.2f}s" if r.get("other_s") is not None
+             else "")
+          + f")   evicted: "
           f"{r.get('evicted_eos', 0)} eos, {r.get('evicted_length', 0)} length")
+        # round-20 dispatch-vs-device attribution: the decode loop's
+        # async-dispatch wall vs the wall spent at the per-quantum sync
+        disp, dev = r.get("dispatch_overhead_s"), r.get("device_s")
+        if disp is not None and dev is not None:
+            tot = max(disp + dev, 1e-12)
+            w(f"  dispatch vs device: {disp:.2f}s dispatch "
+              f"({100 * disp / tot:.0f}%) / {dev:.2f}s device sync "
+              f"({100 * dev / tot:.0f}%)")
+        for ln in _phase_lines(r):
+            w(ln)
         # round-15 paged KV: pool pressure + the prefill work prefix
         # reuse deleted (fields only present on paged runs)
         if r.get("page_size"):
@@ -487,6 +526,8 @@ def summarize(records: list[dict]) -> str:
         if p50 is not None:
             w(f"  fleet latency e2e p50/p99: "
               f"{p50 * 1e3:.1f}/{p99 * 1e3:.1f} ms")
+        for ln in _phase_lines(r):
+            w(ln)
         if r.get("kills") or r.get("requeued"):
             dups = r.get("duplicate_completions", 0)
             w(f"  failures: {r.get('kills', 0)} replica kill(s), "
@@ -885,6 +926,30 @@ def check_min_fleet_tps(records: list[dict], threshold: float) -> tuple[bool, st
     )
 
 
+def check_min_trace_complete(records: list[dict], threshold: float) -> tuple[bool, str]:
+    """Trace-completeness CI gate (`--min_trace_complete`, round 20): the
+    fraction of `kind="trace"` span trees satisfying the completeness
+    invariant (closed — enqueue, >=1 admit, exactly one finish — AND
+    named phase walls summing to <= e2e + 1e-3 s) must reach
+    `threshold`. Returns (ok, message) — a log without trace rows fails,
+    so the gate can't pass vacuously when someone passes `--no_trace` to
+    the smoke invocation (the `--min_accept_rate` discipline)."""
+    trees = _rows(records, "trace")
+    if not trees:
+        return False, ("--min_trace_complete: no trace record in the log "
+                       "(was the run started with --no_trace?)")
+    n_complete = sum(1 for t in trees if t.get("complete"))
+    n_open = sum(1 for t in trees if not t.get("closed"))
+    frac = n_complete / len(trees)
+    ok = frac >= threshold
+    verdict = "OK" if ok else "FAIL"
+    return ok, (
+        f"--min_trace_complete {verdict}: {n_complete}/{len(trees)} span "
+        f"trees complete ({frac:.3f}; {n_open} open; threshold "
+        f"{threshold:.3f})"
+    )
+
+
 def check_min_overlap_frac(records: list[dict], threshold: float) -> tuple[bool, str]:
     """Overlap-schedule gate (`--min_overlap_frac`, round 18): every
     bucketed rung of the bench `comm_overlap` record must have
@@ -960,6 +1025,13 @@ def main(argv=None) -> int:
         "fleet summary) — the fleet-serving regression gate for CI",
     )
     ap.add_argument(
+        "--min_trace_complete", type=float, default=None, metavar="FRACTION",
+        help="assert the fraction of complete request span trees "
+        "(kind=\"trace\" rows: closed AND phase walls summing to e2e "
+        "within 1e-3 s) >= FRACTION (exit 2 below it, or when the log "
+        "has no trace rows) — the tracing-integrity gate for CI",
+    )
+    ap.add_argument(
         "--min_overlap_frac", type=float, default=None, metavar="FRACTION",
         help="assert every bucketed comm_overlap bench rung's "
         "overlap_frac (hlolint-measured hidden-wires fraction) >= "
@@ -987,6 +1059,10 @@ def main(argv=None) -> int:
         rc = rc if ok else 2
     if args.min_fleet_tps is not None:
         ok, msg = check_min_fleet_tps(records, args.min_fleet_tps)
+        print(msg, file=sys.stdout if ok else sys.stderr)
+        rc = rc if ok else 2
+    if args.min_trace_complete is not None:
+        ok, msg = check_min_trace_complete(records, args.min_trace_complete)
         print(msg, file=sys.stdout if ok else sys.stderr)
         rc = rc if ok else 2
     if args.min_overlap_frac is not None:

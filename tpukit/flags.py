@@ -391,6 +391,19 @@ def add_serve_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="longest n-gram the self-speculation "
                         "proposer matches (falls back through shorter "
                         "suffixes down to 1)")
+    # Request-scoped tracing (round 20, tpukit/obs/trace.py): ON by
+    # default — the ring is bounded and the emit cost is inside the
+    # recorder's <1% budget (bench.py obs_overhead serving rung), with
+    # token streams bit-identical either way (tests/test_trace.py).
+    parser.add_argument("--no_trace", action="store_true",
+                        help="disable request-scoped span tracing "
+                        "(kind=\"trace_event\"/\"trace\" JSONL rows, "
+                        "per-phase latency percentiles, traceview export)")
+    parser.add_argument("--trace_capacity", type=int, default=8192,
+                        help="span events retained per replica ring "
+                        "(oldest evicted; evictions break the trace-"
+                        "completeness invariant on long runs — grow this "
+                        "before gating with --min_trace_complete)")
     return parser
 
 

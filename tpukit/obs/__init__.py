@@ -3,7 +3,13 @@
 Supersedes the old flat `tpukit/profiling.py` (now a compat shim). The
 pillars, one per module:
 
-  - `meter`      — MFUMeter (tokens/sec, MFU), `trace`, JSONL `StepLogger`.
+  - `meter`      — MFUMeter (tokens/sec, MFU), `profiler_trace`, JSONL
+                   `StepLogger`.
+  - `trace`      — request-scoped serving traces (round 20):
+                   `TraceRecorder` span-event rings, per-request span
+                   trees with phase walls (queue_wait/prefill/handoff/
+                   decode/sync_stall), the completeness invariant and
+                   the Chrome-trace exporter behind `tools/traceview.py`.
   - `spans`      — `SpanTimeline`: host-phase wall-clock accounting and the
                    goodput breakdown (fraction of time inside the compiled
                    step vs data wait / H2D / checkpoint / eval).
@@ -40,10 +46,20 @@ from tpukit.obs.meter import (  # noqa: F401
     matmul_param_count,
     moe_active_flops_per_token,
     peak_flops_per_chip,
-    trace,
+    profiler_trace,
     train_flops_per_token,
 )
 from tpukit.obs.recorder import FlightRecorder  # noqa: F401
+from tpukit.obs.trace import (  # noqa: F401
+    PHASES,
+    TraceRecorder,
+    build_trees,
+    completeness,
+    flush_to_logger,
+    phase_stats,
+    request_trace_id,
+    to_chrome,
+)
 from tpukit.obs.sentinels import SpikeEvent, SpikeSentinel, global_norms  # noqa: F401
 from tpukit.obs.spans import GOODPUT_SPANS, SpanTimeline, format_breakdown  # noqa: F401
 from tpukit.obs.watchdog import (  # noqa: F401

@@ -8,7 +8,8 @@ Since the driver-defined baseline metric is tokens/sec/chip and MFU
 
   - `MFUMeter`: step timing -> tokens/sec, tokens/sec/chip, and model FLOPs
     utilization against the chip's peak bf16 FLOPs.
-  - `trace` context: wraps `jax.profiler.trace` when a profile dir is set.
+  - `profiler_trace` context: wraps `jax.profiler.trace` when a profile
+    dir is set (request-scoped SERVING traces live in `tpukit.obs.trace`).
   - `StepLogger`: machine-readable JSONL step metrics (the surface
     `tools/report.py` renders).
 
@@ -137,13 +138,21 @@ class MFUMeter:
 
 
 @contextlib.contextmanager
-def trace(profile_dir: str = ""):
-    """jax.profiler trace hook (SURVEY §5 tracing plan). No-op when unset."""
+def profiler_trace(profile_dir: str = ""):
+    """jax.profiler trace hook (SURVEY §5 tracing plan). No-op when unset.
+
+    Renamed from `trace` in round 20: `tpukit.obs.trace` is now the
+    request-scoped serving-trace MODULE, so the profiler hook carries an
+    unambiguous name. The old spelling survives below for the
+    `tpukit.profiling` compat shim."""
     if profile_dir:
         with jax.profiler.trace(profile_dir):
             yield
     else:
         yield
+
+
+trace = profiler_trace  # legacy alias (tpukit/profiling.py shim)
 
 
 class StepLogger:

@@ -23,8 +23,12 @@ the serving telemetry — in the shape real TPU serving engines take:
   - **Serving telemetry** through the SAME stack that covers training
     (spans -> JSONL -> flight recorder -> tools/report.py): per-window
     `kind="serve"` records (tokens/s, occupancy, admit/evict counts,
-    prefill/decode/sync wall split, per-token + end-to-end latency
-    percentiles) and one final `kind="serve_summary"`.
+    prefill/decode/sync wall split + explicit `other_s` residual,
+    per-window dispatch-vs-device attribution, per-token + end-to-end
+    latency percentiles) and one final `kind="serve_summary"`. With a
+    `tracer` (round 20, tpukit/obs/trace.py) the step primitives also
+    emit per-request span events — enqueue/admit/prefill/quantum/finish
+    — merged into span trees with per-phase p50/p99 in the summary.
 
 Sharded serving: pass `mesh` (and params placed at their training
 shardings) and the engine places the KV ring `[L, N, H, W, D]` as
@@ -48,6 +52,7 @@ from jax.sharding import PartitionSpec as P
 
 from tpukit.model import gpt
 from tpukit.obs import SpanTimeline
+from tpukit.obs import trace as trace_lib
 from tpukit.serve import decode as serve_decode
 
 
@@ -56,13 +61,22 @@ class Request:
     """One inference request: a tokenized prompt plus its decode budget.
     `arrival_s` is the offset (seconds, stream-relative) at which the
     request becomes visible to the scheduler — 0 for an offered-up-front
-    batch, spaced for an arrival process."""
+    batch, spaced for an arrival process. `trace` is the request's trace
+    id (round 20, tpukit/obs/trace.py); -1 defaults it to the rid. A
+    requeued-after-kill attempt reuses the SAME Request, so both
+    attempts share one trace id by construction."""
 
     rid: int
     ids: tuple[int, ...]
     max_new_tokens: int = 20
     seed: int = 0
     arrival_s: float = 0.0
+    trace: int = -1
+
+
+def trace_id(req: Request) -> int:
+    """Effective trace id (trace_lib.request_trace_id over a Request)."""
+    return req.trace if req.trace >= 0 else req.rid
 
 
 @dataclasses.dataclass
@@ -352,7 +366,8 @@ class ServeEngine:
 
     def __init__(self, params, cfg: gpt.GPTConfig, serve: ServeConfig,
                  eos_id: int, mesh=None, logger=None, recorder=None,
-                 draft_params=None, draft_cfg=None, replica=None):
+                 draft_params=None, draft_cfg=None, replica=None,
+                 tracer=None):
         if serve.kv_width > cfg.max_position_embeddings:
             raise ValueError(
                 f"KV ring width {serve.kv_width} (max bucket "
@@ -412,6 +427,13 @@ class ServeEngine:
         # can aggregate per-replica telemetry. None = standalone engine,
         # records unchanged.
         self.replica = replica
+        # Request-scoped tracing (round 20, tpukit/obs/trace.py): a
+        # shared TraceRecorder the step primitives emit span events into.
+        # None = tracing off — every tracer touch below is gated so the
+        # token stream and schedule are bit-identical either way
+        # (asserted in tests/test_trace.py).
+        self.tracer = tracer
+        self._pending_quantum = None  # dispatch half of the quantum event
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         # lax.top_k rejects k beyond the logits width — clamp like generate()
@@ -574,6 +596,7 @@ class ServeEngine:
             groups.setdefault(bucket, []).append(
                 (self._free.popleft(), req, prompt_len)
             )
+        tr = self.tracer
         for bucket, entries in sorted(groups.items()):
             a = 1 << (len(entries) - 1).bit_length()  # pad to power of two
             rows = np.zeros((a, bucket), np.int32)
@@ -587,6 +610,7 @@ class ServeEngine:
                 slots[i], plens[i] = slot, plen
                 lims[i] = min(plen + req.max_new_tokens, self.serve.width)
                 keys[i] = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
+            p0 = tr.now() if tr is not None else 0.0
             with self.spans.span("prefill"):
                 (self.buf, self.cache, self.cursors, self.active, self.limits,
                  self.keys) = serve_decode.prefill_slots(
@@ -610,9 +634,18 @@ class ServeEngine:
                         self._place(keys, P()),
                     )
             self.buckets_used.add(bucket)
+            p1 = tr.now() if tr is not None else 0.0
             for slot, req, plen in entries:
                 self._lanes[slot] = _Lane(req, now, plen, bucket, active_s=now)
                 self.admitted += 1
+                if tr is not None:
+                    tid = trace_id(req)
+                    tr.emit("admit", tid, rid=req.rid, t=now, slot=slot,
+                            replica=self.replica)
+                    tr.emit("prefill", tid, rid=req.rid, t0=p0, t1=p1,
+                            chunk=0, replica=self.replica)
+                    tr.emit("prefill_done", tid, rid=req.rid, t=p1,
+                            replica=self.replica)
         self.max_live = max(self.max_live, len(self._lanes))
 
     # ---- paged scheduling (round 15) -------------------------------------
@@ -668,6 +701,9 @@ class ServeEngine:
         self.admitted += 1
         self.max_live = max(self.max_live, len(self._lanes))
         self.buckets_used.add(bucket)
+        if self.tracer is not None:
+            self.tracer.emit("admit", trace_id(req), rid=req.rid, t=now,
+                             slot=slot, replica=self.replica)
         if shared:
             self.allocator.stats.prefix_hits += 1
             self.allocator.stats.prefix_pages_reused += len(shared)
@@ -712,6 +748,8 @@ class ServeEngine:
                           self.serve.width)
             keys[i] = lane.key
         self._refresh_bt()
+        tr = self.tracer
+        p0 = tr.now() if tr is not None else 0.0
         with self.spans.span("prefill"):
             (self.buf, self.cache, self.cursors, self.active, self.limits,
              self.keys) = serve_decode.prefill_chunk_paged(
@@ -722,8 +760,16 @@ class ServeEngine:
                 self._place(plens, P()), self._place(lims, P()),
                 self._place(keys, P()),
             )
+        p1 = tr.now() if tr is not None else 0.0
         for slot, lane, start, row, is_last in entries:
             lane.next_chunk = start + c
+            if tr is not None:
+                tid = trace_id(lane.req)
+                tr.emit("prefill", tid, rid=lane.req.rid, t0=p0, t1=p1,
+                        chunk=start // c, replica=self.replica)
+                if is_last:
+                    tr.emit("prefill_done", tid, rid=lane.req.rid, t=p1,
+                            replica=self.replica)
             if is_last:
                 lane.phase = "decode"
                 lane.active_s = now
@@ -741,12 +787,23 @@ class ServeEngine:
     def _step(self) -> None:
         if self.serve.paged:
             self._refresh_bt()
+        tr = self.tracer
+        t0 = tr.now() if tr is not None else 0.0
         with self.spans.span("decode"):
             self.buf, self.cache, self.cursors, self.active = serve_decode.decode_step(
                 self.params, self.cfg, self.buf, self.cache, self.cursors,
                 self.active, self.limits, self.keys, self.eos_id,
                 float(self.serve.temperature), self._top_k, self.mesh,
                 steps=self.serve.decode_quantum,
+            )
+        if tr is not None:
+            # dispatch half of the quantum event; `sync()` adds the
+            # wall-to-sync half and emits (one ring record per quantum,
+            # not per lane — the ring stays O(quanta))
+            self._pending_quantum = dict(
+                t0=t0, t1=tr.now(), steps=self.serve.decode_quantum,
+                lanes=[trace_id(l.req) for s, l in sorted(self._lanes.items())
+                       if l.phase == "decode"],
             )
         self.steps += self.serve.decode_quantum
         self._win["steps"] += self.serve.decode_quantum
@@ -769,6 +826,8 @@ class ServeEngine:
         for s, lane in self._lanes.items():
             if lane.phase == "decode":
                 live[s] = True
+        tr = self.tracer
+        t0 = tr.now() if tr is not None else 0.0
         if self.serve.draft == "model":
             with self.spans.span("draft"):
                 draft_toks, draft_q, self.draft_cache = spec_lib.draft_propose(
@@ -806,6 +865,12 @@ class ServeEngine:
                     mesh=self.mesh,
                 )
         self._pending_spec = (live, dlen, acc, napp)
+        if tr is not None:
+            self._pending_quantum = dict(
+                t0=t0, t1=tr.now(), steps=1,
+                lanes=[trace_id(l.req) for s, l in sorted(self._lanes.items())
+                       if l.phase == "decode"],
+            )
         self.steps += 1
         self._win["steps"] += 1
 
@@ -828,6 +893,8 @@ class ServeEngine:
         """The per-step host sync: fetch cursors + active flags, retire
         lanes that finished, and account generated tokens. One small D2H
         per step — the price of host-side EOS detection."""
+        tr = self.tracer
+        s0 = tr.now() if tr is not None else 0.0
         with self.spans.span("sync"):
             if self._pending_spec is not None:
                 # coalesce the spec counters into the same D2H round trip
@@ -842,6 +909,15 @@ class ServeEngine:
                 cur, act = map(np.asarray,
                                jax.device_get((self.cursors, self.active)))
             self._drain_spec()
+        if tr is not None and self._pending_quantum is not None:
+            # complete the dispatch+sync pair started in _step/_spec_step:
+            # [t0,t1] is the async-dispatch wall, [s0,s1] the wall-to-sync
+            # (device) wall — the per-quantum attribution ROADMAP #3 wants
+            q = self._pending_quantum
+            self._pending_quantum = None
+            tr.emit("quantum", -1, t0=q["t0"], t1=q["t1"], s0=s0,
+                    s1=tr.now(), steps=q["steps"], lanes=q["lanes"],
+                    replica=self.replica)
         # prefilling paged lanes are act=False by design, not finished
         finished = [
             s for s, lane in self._lanes.items()
@@ -854,6 +930,7 @@ class ServeEngine:
         )
         if finished:
             host_buf = np.asarray(jax.device_get(self.buf))
+            fin_t = tr.now() if tr is not None else 0.0
             for s in finished:
                 lane = self._lanes.pop(s)
                 length = int(cur[s])
@@ -882,6 +959,14 @@ class ServeEngine:
                     pages=len(lane.pages), prefix_pages=lane.shared,
                     active_s=lane.active_s or lane.admit_s,
                 ))
+                if tr is not None:
+                    # finish is stamped POST-sync (fin_t > done_s=now,
+                    # which was captured pre-sync): the last quantum's
+                    # sync wall belongs inside the tree's lifetime, so
+                    # the phase walls can sum to the tree's e2e
+                    tr.emit("finish", trace_id(lane.req), rid=lane.req.rid,
+                            t=fin_t, reason=reason, generated=generated,
+                            replica=self.replica)
                 if self.serve.paged:
                     # drop this lane's references: private pages free (or
                     # retire into the prefix LRU if registered), shared
@@ -921,6 +1006,17 @@ class ServeEngine:
             p99_e2e_s=_pct([c.e2e_s for c in comps], 99),
             p50_token_s=_pct([c.per_token_s for c in comps], 50),
             p99_token_s=_pct([c.per_token_s for c in comps], 99),
+            # explicit residual (round 20, the fit() goodput discipline):
+            # the window's named spans + other_s sum to window_s exactly
+            # — drift can't silently vanish
+            other_s=b["seconds"].get("other", 0.0),
+            # per-window dispatch-vs-device attribution (ROADMAP #3):
+            # decode/draft/verify spans ARE the async dispatch calls;
+            # the device's compute wall surfaces as the sync span
+            dispatch_overhead_s=(b["seconds"].get("decode", 0.0)
+                                 + b["seconds"].get("draft", 0.0)
+                                 + b["seconds"].get("verify", 0.0)),
+            device_s=b["seconds"].get("sync", 0.0),
         )
         if self.serve.paged:
             # the paged health triple (round 15): pool pressure, how much
@@ -995,6 +1091,17 @@ class ServeEngine:
         rec["prefill_s"] = ep["seconds"].get("prefill", 0.0)
         rec["decode_s"] = ep["seconds"].get("decode", 0.0)
         rec["sync_s"] = ep["seconds"].get("sync", 0.0)
+        # wall clock outside every span, surfaced instead of silently
+        # vanishing (the run loop resets the span epoch at its t0, so a
+        # standalone run's named + other walls sum to wall_s)
+        named = (rec["prefill_s"] + rec["decode_s"] + rec["sync_s"]
+                 + ep["seconds"].get("draft", 0.0)
+                 + ep["seconds"].get("verify", 0.0))
+        rec["other_s"] = max(wall_s - named, 0.0)
+        rec["dispatch_overhead_s"] = (rec["decode_s"]
+                                      + ep["seconds"].get("draft", 0.0)
+                                      + ep["seconds"].get("verify", 0.0))
+        rec["device_s"] = rec["sync_s"]
         rec["max_live_slots"] = self.max_live
         rec["kv_bytes"] = self.kv_bytes
         if self.serve.draft:
@@ -1024,6 +1131,15 @@ class ServeEngine:
                 admit_latency_hit_s=float(np.mean(hit)) if hit else None,
                 admit_latency_cold_s=float(np.mean(cold)) if cold else None,
             )
+        if self.tracer is not None:
+            # per-request phase latency percentiles from THIS engine's
+            # completed span trees (the tracer may be fleet-shared, so
+            # restrict to our own completions)
+            rids = {c.rid for c in comps}
+            trees = [t for t in trace_lib.build_trees(self.tracer.snapshot())
+                     if t["rid"] in rids]
+            rec["phase_p50"], rec["phase_p99"] = trace_lib.phase_stats(trees)
+            rec["trace_complete"] = trace_lib.completeness(trees)
         return rec
 
     # ---- step primitives (the fleet hooks, round 19) ---------------------
@@ -1116,6 +1232,15 @@ class ServeEngine:
                 tokens_per_sec=rec["tokens_per_sec"],
                 mean_occupancy=rec["mean_occupancy"],
             )
+        if self.tracer is not None and self.replica is None:
+            # standalone epilogue: persist the ring + span trees into the
+            # JSONL (fleet replicas share the router's tracer — the
+            # router flushes ONCE at fleet shutdown, covering killed
+            # replicas that never reach finish())
+            trace_lib.flush_to_logger(
+                self.tracer, self.logger,
+                trace_lib.build_trees(self.tracer.snapshot()),
+            )
         return self.completions
 
     def requeue_live(self) -> list[Request]:
@@ -1196,6 +1321,9 @@ class ServeEngine:
         )
         self.admitted += 1
         self.max_live = max(self.max_live, len(self._lanes))
+        if self.tracer is not None:
+            self.tracer.emit("adopt", trace_id(req), rid=req.rid, t=now,
+                             slot=slot, replica=self.replica)
         if shared:
             self.allocator.stats.prefix_hits += 1
             self.allocator.stats.prefix_pages_reused += shared
@@ -1212,7 +1340,16 @@ class ServeEngine:
         and a final `kind="serve_summary"`; returns the completions in
         finish order."""
         self._pending = deque(sorted(requests, key=lambda r: (r.arrival_s, r.rid)))
+        # reset the span epoch to the RUN start (round 20): the timeline
+        # was constructed earlier, and the construction->run gap would
+        # otherwise leak into the summary's `other_s` residual
+        self.spans.epoch()
         t0 = time.perf_counter()
+        if self.tracer is not None:
+            self.tracer.set_epoch(t0)
+            for r in self._pending:
+                self.tracer.emit("enqueue", trace_id(r), rid=r.rid,
+                                 t=r.arrival_s, replica=self.replica)
         now = 0.0
         while self._pending or self._lanes:
             now = time.perf_counter() - t0

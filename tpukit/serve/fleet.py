@@ -55,6 +55,12 @@ Telemetry: replicas emit their usual `kind="serve"` windows tagged
 tokens/s, per-replica occupancy, queue depth), `kind="fleet_event"`
 (scale/kill/requeue) and one `kind="fleet_summary"` — rendered by
 `tools/report.py` "== fleet ==" with the `--min_fleet_tps` CI gate.
+With a shared `tracer` (round 20, tpukit/obs/trace.py) the router also
+emits route/handoff/requeue span events — merged with the replicas'
+admit/prefill/quantum/finish events into per-request span trees whose
+fleet-wide per-phase p50/p99 and completeness land on the summary, and
+which flush to `kind="trace_event"`/`kind="trace"` JSONL rows for the
+`--min_trace_complete` gate and `tools/traceview.py`.
 """
 
 from __future__ import annotations
@@ -66,8 +72,15 @@ from collections import deque
 import numpy as np
 
 from tpukit import chaos as chaos_lib
+from tpukit.obs import trace as trace_lib
 from tpukit.serve import paged as paged_lib
-from tpukit.serve.engine import Completion, Request, ServeConfig, ServeEngine
+from tpukit.serve.engine import (
+    Completion,
+    Request,
+    ServeConfig,
+    ServeEngine,
+    trace_id,
+)
 
 
 def pick_serve_grid(n_devices: int, heads: int, slots: int,
@@ -237,7 +250,7 @@ class FleetRouter:
 
     def __init__(self, params_host, cfg, serve: ServeConfig,
                  fleet: FleetConfig, eos_id: int, *, devices=None,
-                 logger=None, recorder=None):
+                 logger=None, recorder=None, tracer=None):
         import jax
 
         if serve.draft and fleet.disagg_prefill:
@@ -264,6 +277,11 @@ class FleetRouter:
         self.eos_id = int(eos_id)
         self.logger = logger
         self.recorder = recorder
+        # ONE TraceRecorder shared by the router, every replica and the
+        # prefill worker (round 20): fleet span trees need a single
+        # clock and ring set that survives replica kills, so the router
+        # owns it and flushes it once at fleet shutdown.
+        self.tracer = tracer
         self._params_host = params_host
         self.placements = 0
         self._placed: dict[int, object] = {}  # subset idx -> placed params
@@ -322,7 +340,7 @@ class FleetRouter:
             self.prefill = ServeEngine(
                 self._place_for(wmesh, subset_idx=-1), cfg, wcfg,
                 eos_id=self.eos_id, mesh=wmesh, logger=None, recorder=None,
-                replica="prefill",
+                replica="prefill", tracer=self.tracer,
             )
 
         # kill plan: dispatch round -> list of target ids (None = highest)
@@ -361,7 +379,7 @@ class FleetRouter:
         eng = ServeEngine(
             self._place_for(mesh, subset_idx=idx), self.cfg, self.serve,
             eos_id=self.eos_id, mesh=mesh, logger=self.logger,
-            recorder=self.recorder, replica=idx,
+            recorder=self.recorder, replica=idx, tracer=self.tracer,
         )
         self._replicas[idx] = eng
         self.replicas_peak = max(self.replicas_peak, len(self._replicas))
@@ -411,6 +429,9 @@ class FleetRouter:
             best = max(targets, key=lambda e: (free[id(e)], e.free_pages))
             assign[id(best)].append(req)
             free[id(best)] -= 1
+            if self.tracer is not None:
+                self.tracer.emit("route", trace_id(req), rid=req.rid,
+                                 t=now, dst=best.replica, replica="router")
         leftovers: list[Request] = []
         for e in targets:
             leftovers.extend(e.admit(assign[id(e)], now))
@@ -447,6 +468,8 @@ class FleetRouter:
         False (nothing mutated) when the destination pool cannot cover
         the footprint."""
         req, plen = lane.req, lane.prompt_len
+        tr = self.tracer
+        h0 = tr.now() if tr is not None else 0.0
         p = self.serve.page_size
         written = -(-lane.prefill_end // p)  # pages holding computed K/V
         matched = dst.allocator.lookup_prefix(req.ids, (plen - 1) // p)
@@ -457,12 +480,19 @@ class FleetRouter:
             dst.allocator.release(matched)
             return False
         pages = list(matched) + fresh
+        c0 = tr.now() if tr is not None else 0.0
         _copy_pages(worker, dst,
                     lane.pages[len(matched):written],
                     fresh[: written - len(matched)])
+        c1 = tr.now() if tr is not None else 0.0
         dst.adopt_prefilled(req, pages, len(matched), lane.admit_s, now,
                             lane.key)
         worker.release_lane(slot)
+        if tr is not None:
+            tr.emit("handoff", trace_id(req), rid=req.rid, t0=h0,
+                    t1=tr.now(), claim_s=c0 - h0, copy_s=c1 - c0,
+                    pages=written - len(matched), dst=dst.replica,
+                    replica="router")
         return True
 
     # ---- failure + autoscale --------------------------------------------
@@ -505,6 +535,13 @@ class FleetRouter:
         self.requeued += len(victims)
         for req in reversed(victims):
             self._pending.appendleft(req)
+        if self.tracer is not None:
+            # the requeue event links the killed attempt and the retry
+            # under ONE trace id — the same Request object re-queues, so
+            # the retry's admit/finish land on the same tree
+            for req in victims:
+                self.tracer.emit("requeue", trace_id(req), rid=req.rid,
+                                 t=now, from_replica=idx, replica="router")
         self._event("replica_kill", replica=idx, round=rounds,
                     requeued=len(victims),
                     requeued_rids=[r.rid for r in victims])
@@ -626,6 +663,15 @@ class FleetRouter:
                 worker_prefix_hits=st.prefix_hits,
                 worker_pages_reused=st.prefix_pages_reused,
             )
+        if self.tracer is not None:
+            # fleet-wide per-phase latency view over every completed
+            # request's span tree (killed-replica work included — the
+            # shared tracer outlives its emitters)
+            done_rids = {c.rid for c in comps}
+            trees = [t for t in trace_lib.build_trees(self.tracer.snapshot())
+                     if t["rid"] in done_rids]
+            rec["phase_p50"], rec["phase_p99"] = trace_lib.phase_stats(trees)
+            rec["trace_complete"] = trace_lib.completeness(trees)
         return rec
 
     # ---- the loop --------------------------------------------------------
@@ -643,7 +689,18 @@ class FleetRouter:
             sorted(requests, key=lambda r: (r.arrival_s, r.rid))
         )
         pending = self._pending
+        # reset every engine's span epoch to the FLEET run start so the
+        # construction->run gap lands nowhere (the engine.run discipline)
+        for eng in self._replicas.values():
+            eng.spans.epoch()
+        if self.prefill is not None:
+            self.prefill.spans.epoch()
         t0 = time.perf_counter()
+        if self.tracer is not None:
+            self.tracer.set_epoch(t0)
+            for r in pending:
+                self.tracer.emit("enqueue", trace_id(r), rid=r.rid,
+                                 t=r.arrival_s, replica="router")
         self._win["t0"] = 0.0
         rounds = 0
         while pending or self._any_lanes():
@@ -703,6 +760,14 @@ class FleetRouter:
                 "fleet_summary", requests=rec["requests"],
                 tokens_per_sec=rec["tokens_per_sec"],
                 requeued=rec["requeued"], kills=rec["kills"],
+            )
+        if self.tracer is not None:
+            # one flush for the whole fleet: events + span trees into the
+            # JSONL (replica engines share this tracer and skip their own
+            # flush — see ServeEngine.finish)
+            trace_lib.flush_to_logger(
+                self.tracer, self.logger,
+                trace_lib.build_trees(self.tracer.snapshot()),
             )
         self._done.sort(key=lambda c: c.done_s)
         return self._done

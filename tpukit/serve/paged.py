@@ -57,6 +57,14 @@ Write-safety invariants (everything here leans on them):
      the causal `key_pos <= q_pos` window exactly like the ring path's
      stale-tail garbage, which softmax turns into exact zeros. Same
      argument, same tests.
+
+Observability (round 20): the pool itself emits nothing — page claims and
+cross-pool copies during a disaggregated-prefill handoff are timed by the
+ROUTER (`fleet.FleetRouter._adopt` emits the `handoff` span event with
+`claim_s`/`copy_s`/`pages` into the request's trace; tpukit/obs/trace.py),
+keeping this module free of telemetry plumbing: it stays a pure
+allocator + layout library, and handoff cost is attributed where the
+decision was made.
 """
 
 from __future__ import annotations

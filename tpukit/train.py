@@ -84,7 +84,7 @@ from tpukit.obs import (
     global_norms,
     live_memory_stats,
     make_state_checksum,
-    trace,
+    profiler_trace,
 )
 from tpukit.sampling import generate_batch
 from tpukit.shardings import Strategy
@@ -1388,7 +1388,7 @@ def _fit_body(
     # loop, so eval_metrics must exist before it.
     eval_metrics = {}
     with contextlib.ExitStack() as _obs_guard, maybe_nojit, maybe_nans, \
-            trace(flags.profile_dir), contextlib.ExitStack() as _cleanup:
+            profiler_trace(flags.profile_dir), contextlib.ExitStack() as _cleanup:
         _obs_guard.callback(_close_obs)
         for epoch in range(start_epoch, epochs):
             # ---- train ---------------------------------------------------

@@ -49,6 +49,12 @@ CPU-loopback caveat in-record. Round 20 adds the
 split from the request tracer's quantum spans) and a `serving` rung
 inside `obs_overhead` (the trace recorder on vs off on the same seeded
 stream: tokens/s delta under the 1% bar, bit-identical output tokens).
+The `decode_fused` record (round 21, ROADMAP #2/#4) isolates the two
+`--fused_decode` wins: unfused-gather vs fused-kernel at decode_quantum=1
+(the kernel delta; interpret-mode CPU states its inversion honestly) and
+fused q=1 vs the on-device while-loop window (the dispatch-amortization
+delta, which transfers — the kernel cost cancels), with three-way token
+parity and per-quantum dispatch/device walls.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -692,6 +698,111 @@ def bench_serve_dispatch_attribution(cfg, n_dev, requests=32, slots=8,
         "summary_device_s": round(s.get("device_s", 0.0), 4),
         "trace_complete": completeness(trees),
         "completed": len(comps),
+    }
+
+
+def bench_decode_fused(cfg, n_dev, requests=24, slots=4, max_new=12,
+                       window=8):
+    """Fused-decode ladder (round 21, ROADMAP #2/#4): the two wins behind
+    `--fused_decode`, measured SEPARATELY so neither can hide behind the
+    other:
+
+      - "unfused_q1" vs "fused_q1" (both at decode_quantum=1): the pure
+        KERNEL delta — the per-layer XLA gather+attend against the fused
+        paged-attention pallas_call, with the host dispatch cadence held
+        identical. On a real TPU this is the no-materialized-view win; on
+        CPU loopback the kernel runs in pallas INTERPRET mode (a scan
+        over the grid) and is honestly SLOWER — the ratio still lands in
+        the record because hiding it would defeat the point.
+      - "fused_q1" vs "fused_loop" (decode_quantum=window): the
+        DISPATCH-AMORTIZATION delta — the same kernel, but the scheduler
+        state machine lives on device and one `while_loop` dispatch
+        covers the whole window. The round-20 attribution priced the
+        per-quantum host overhead at ~0.3 ms against ~0.7 ms device
+        work; this ratio is that attribution cashed in, and because the
+        kernel cost is IDENTICAL in numerator and denominator the
+        interpret-mode slowness cancels — the amortization number
+        transfers from this container.
+
+    Every rung reruns the round-20 trace plumbing (quantum spans carry
+    the device-reported tick count for the loop rung), so the record
+    cross-checks mean per-quantum dispatch/device walls against the
+    `serve_dispatch_attribution` record, and `parity_ok` pins all three
+    rungs token-identical per request."""
+    import time
+
+    import jax
+
+    from tpukit.data import get_tokenizer
+    from tpukit.model import init_params
+    from tpukit.obs import TraceRecorder, build_trees, completeness
+    from tpukit.serve import ServeConfig, ServeEngine, synthetic_request_stream
+
+    import jax.numpy as jnp
+
+    tokenizer = get_tokenizer()
+    tokenizer.pad_token_id = 2
+    # f32 compute: the parity bit across rungs is exact-token equality
+    cfg = cfg.replace(vocab_size=tokenizer.vocab_size,
+                      compute_dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    buckets = lengths = (8, 16)
+    page = 8
+    eos = int(tokenizer.eos_token_id)
+    stream = list(synthetic_request_stream(
+        tokenizer, requests, seed=0, max_new_tokens=max_new,
+        buckets=buckets, lengths=lengths,
+    ))
+    pages = slots * (-(-(max(buckets) + max_new) // page)) + 1
+
+    def run(fused, quantum):
+        serve = ServeConfig(
+            slots=slots, buckets=buckets, max_new_tokens=max_new,
+            window_steps=10**9, page_size=page, num_pages=pages,
+            fused_decode=fused, decode_quantum=quantum,
+        )
+        ServeEngine(params, cfg, serve, eos_id=eos).run(
+            list(stream), max_wall_s=900)  # warm: absorbs compiles
+        tracer = TraceRecorder()
+        eng = ServeEngine(params, cfg, serve, eos_id=eos, tracer=tracer)
+        t0 = time.perf_counter()
+        comps = eng.run(list(stream), max_wall_s=900)
+        wall = time.perf_counter() - t0
+        gen = sum(c.generated for c in comps)
+        quanta = [e for e in tracer.snapshot() if e.get("ev") == "quantum"]
+        disp = sum(q["t1"] - q["t0"] for q in quanta)
+        dev = sum(q["s1"] - q["s0"] for q in quanta if "s1" in q)
+        rec = {
+            "tokens_per_sec": round(gen / wall, 1),
+            "wall_s": round(wall, 3),
+            "generated_tokens": gen,
+            "quanta": len(quanta),
+            "decode_steps": eng.steps,
+            "mean_dispatch_ms_per_quantum": round(1e3 * disp / len(quanta), 3)
+            if quanta else None,
+            "mean_device_ms_per_quantum": round(1e3 * dev / len(quanta), 3)
+            if quanta else None,
+            "trace_complete": completeness(build_trees(tracer.snapshot())),
+        }
+        return rec, {c.rid: list(map(int, c.ids)) for c in comps}
+
+    unfused, toks_u = run(False, 1)
+    fused_q1, toks_f1 = run(True, 1)
+    fused_loop, toks_fl = run(True, window)
+    return {
+        "requests": requests, "slots": slots, "max_new_tokens": max_new,
+        "page_size": page, "window_quanta": window,
+        "unfused_q1": unfused, "fused_q1": fused_q1,
+        "fused_loop": fused_loop,
+        "parity_ok": bool(toks_u == toks_f1 == toks_fl),
+        # the kernel win (interpret-mode CPU: expect < 1, stated honestly)
+        "kernel_speedup": round(
+            fused_q1["tokens_per_sec"] / unfused["tokens_per_sec"], 3)
+        if unfused["tokens_per_sec"] else None,
+        # the dispatch-amortization win (kernel cost cancels: transfers)
+        "amortization_speedup": round(
+            fused_loop["tokens_per_sec"] / fused_q1["tokens_per_sec"], 3)
+        if fused_q1["tokens_per_sec"] else None,
     }
 
 
@@ -1680,6 +1791,18 @@ def main(argv=None):
         print(f"serve dispatch attribution probe failed: {exc!r}",
               file=sys.stderr)
 
+    # Fused decode (round 21, ROADMAP #2/#4): the kernel win (unfused vs
+    # fused at quantum=1) and the dispatch-amortization win (fused q=1 vs
+    # the on-device while-loop window) measured separately, with parity
+    # and per-quantum dispatch/device walls cross-checking the round-20
+    # attribution record.
+    decode_fused_rec = None
+    try:
+        decode_fused_rec = bench_decode_fused(cfg, n_dev)
+    except Exception as exc:
+        decode_fused_rec = {"error": repr(exc)}
+        print(f"fused decode probe failed: {exc!r}", file=sys.stderr)
+
     # Fleet serving (round 19, ROADMAP #1): 1 vs 2 vs 4 replicas on the
     # same stream at equal total devices — fleet tokens/s scaling (>1.5x
     # at 2 replicas is the bar), p99 under load, per-request parity, and
@@ -1763,6 +1886,7 @@ def main(argv=None):
         "paged_kv": paged_kv_rec,
         "spec_decode": spec_decode_rec,
         "serve_dispatch_attribution": serve_dispatch_rec,
+        "decode_fused": decode_fused_rec,
         "fleet_serving": fleet_serving_rec,
         "host_pipeline": host_pipeline,
         "host_pipeline_error": host_pipeline_err,

@@ -57,6 +57,25 @@ prefixes to decode replicas as pages; `--scale_up_occupancy` /
 windows. `kind="fleet"` telemetry renders via tools/report.py
 "== fleet ==" with `--min_fleet_tps` as the CI gate.
 
+Round 21 (ROADMAP #2/#4): `--fused_decode` chases the decode hardware
+ceiling on two axes at once. Per step, paged attention runs as ONE
+fused Pallas kernel (tpukit/ops/paged_attention.py): the block table is
+scalar-prefetched and dereferenced INSIDE the kernel — no per-layer XLA
+gather materializing a [slots, window] contiguous KV view — and int8
+pages dequantize tile-by-tile in VMEM on the quant_comm block layout.
+Per quantum, the scheduler inner state (cursors, EOS flags, length
+limits, freed-page account) lives on device and `--decode_quantum` steps
+run as one `lax.while_loop` (decode.decode_loop_window), so the ~0.3 ms
+host dispatch the round-20 traces measured per step is paid once per
+quantum instead of once per step; the host syncs only at window
+boundaries (or early, when EOS activity frees enough pages for the
+head-of-queue admit). Token streams are exactly those of the unfused
+engine (greedy and seeded sampling; kernel math is op-for-op identical,
+~1-ULP dot reassociation only); bench.py's `decode_fused` record
+measures the kernel and amortization wins separately and
+`tools/report.py --min_decode_speedup` gates the latter. Needs the
+paged cache (`--page_size`).
+
 Run examples:
   python main-serve.py --requests 64 --slots 8 --metrics_log serve.jsonl
   python main-serve.py --checkpoint latest --temperature 0.8 --top_k 40
@@ -361,9 +380,11 @@ def main(argv=None):
         max_new_tokens=flags.max_new_tokens,
         temperature=flags.temperature, top_k=flags.top_k,
         window_steps=flags.window_steps,
+        decode_quantum=flags.decode_quantum,
         page_size=flags.page_size, num_pages=flags.num_pages,
         kv_dtype=flags.kv_dtype, prefill_chunk=flags.prefill_chunk,
         draft=flags.draft, spec_k=flags.spec_k, ngram_max=flags.ngram_max,
+        fused_decode=flags.fused_decode,
     )
     # Request-scoped tracing (round 20): on by default — the recorder is a
     # bounded ring of host-side span events, asserted <1% overhead and
@@ -469,9 +490,11 @@ def _run_fleet(flags, cfg, tokenizer, buckets) -> int:
         max_new_tokens=flags.max_new_tokens,
         temperature=flags.temperature, top_k=flags.top_k,
         window_steps=flags.window_steps,
+        decode_quantum=flags.decode_quantum,
         page_size=flags.page_size, num_pages=flags.num_pages,
         kv_dtype=flags.kv_dtype, prefill_chunk=flags.prefill_chunk,
         draft=flags.draft, spec_k=flags.spec_k, ngram_max=flags.ngram_max,
+        fused_decode=flags.fused_decode,
     )
     fleet = FleetConfig(
         replicas=flags.replicas,

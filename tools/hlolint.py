@@ -6,7 +6,8 @@ Two modes:
   - `--world N` (the CI lane): compile the audited worlds on N virtual
     CPU devices — the dryrun's strategy set (DDP/FSDP f32+int8, the EP
     a2a dispatch f32+int8, the round-18 overlapped DDP/FSDP/EP bucket
-    schedules) plus the serving decode steps (TP ring, paged) — and run
+    schedules) plus the serving decode steps (TP ring, paged, and the
+    round-21 fused-kernel step + on-device scheduler while-loop) — and run
     the full rule engine (tpukit/analysis/rules.py) over each: CommPlan
     diff, involuntary-remat, s32-index-plumbing, wire-upcast,
     donation-dropped, overlap (GATING on the *_overlap worlds — their
@@ -75,6 +76,13 @@ WORLDS = (
     # router adds ZERO collectives, so the plan is the standalone decode
     # closed form unchanged (analysis.plan.fleet_decode_comm_plan)
     "fleet_decode",
+    # round 21 (--fused_decode): the paged decode step with the fused
+    # paged-attention pallas kernel (shard_map, zero body collectives —
+    # the plan is paged_decode's closed form UNCHANGED), and the whole
+    # on-device scheduler window as one while_loop program (the body's
+    # collectives must be attributed ONCE by the body-membership parser,
+    # so the per-step plan gates any window size)
+    "paged_fused", "sched_loop",
 )
 
 # the golden-fixture subset checked into tests/fixtures/hlo/ (ISSUE 12);
@@ -84,6 +92,7 @@ FIXTURE_WORLDS = (
     "ddp_f32", "ddp_int8", "fsdp_f32", "fsdp_int8",
     "ep_a2a", "tp_decode", "paged_decode",
     "ddp_overlap", "fsdp_overlap",
+    "paged_fused", "sched_loop",
 )
 
 
@@ -190,12 +199,18 @@ def _decode_world(name: str, n_devices: int) -> dict:
     from tpukit.serve.decode import decode_step
     from tpukit.shardings import TensorParallel
 
-    paged = name == "paged_decode"
+    # round 21: paged_fused / sched_loop share paged_decode's state but
+    # flip cfg.fused_decode — the whole point of their audit is that the
+    # fused kernel (and the while-loop window around it) changes ZERO
+    # bytes of the comm plan vs the unfused paged_decode world
+    fused = name in ("paged_fused", "sched_loop")
+    paged = name == "paged_decode" or fused
     spec = name == "spec_verify"
     fleet = name == "fleet_decode"
     cfg = GPTConfig(
         dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=160,
         max_position_embeddings=64, compute_dtype=jnp.float32,
+        fused_decode=fused,
     )
     if fleet:
         # a fleet replica's grid: model-parallel over a NON-LEADING device
@@ -257,6 +272,22 @@ def _decode_world(name: str, n_devices: int) -> dict:
                 params, cfg, buf, cache, cursors, active, limits, keys,
                 1, 0.0, 0, k=spec_k, max_ngram=3, mesh=mesh,
             ).compile()
+        elif name == "sched_loop":
+            # the on-device scheduler window: decode_quantum steps as ONE
+            # while_loop program. max_ticks / stop_when_freed are traced
+            # i32 scalars, so this very executable serves EVERY window
+            # size — and the body's collectives must be attributed once
+            # (body membership) for the per-step closed form to gate it.
+            from tpukit.serve.decode import decode_loop_window
+
+            ph = jax.device_put(
+                np.full((slots,), mp, np.int32), sh(P(None))
+            )
+            compiled = decode_loop_window.lower(
+                params, cfg, buf, cache, cursors, active, limits, keys,
+                ph, jnp.asarray(8, jnp.int32),
+                jnp.asarray(1 << 30, jnp.int32), 3, 0.0, 0, mesh,
+            ).compile()
         else:
             compiled = decode_step.lower(
                 params, cfg, buf, cache, cursors, active, limits, keys,
@@ -283,7 +314,8 @@ def build_world(name: str, n_devices: int) -> dict:
     {name, text, stderr, plan, expect_donated, comm_dtype}."""
     if name not in WORLDS:
         raise SystemExit(f"unknown world {name!r} — known: {', '.join(WORLDS)}")
-    if name in ("tp_decode", "paged_decode", "spec_verify", "fleet_decode"):
+    if name in ("tp_decode", "paged_decode", "spec_verify", "fleet_decode",
+                "paged_fused", "sched_loop"):
         return _decode_world(name, n_devices)
     return _train_world(name, n_devices)
 

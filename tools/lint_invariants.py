@@ -45,6 +45,23 @@ re-break in review because the broken form LOOKS idiomatic:
                      reasoned waivers (activation re-layout inside the
                      attention schedule, audited by CP's comm_ops — not
                      a grad/dispatch wire).
+  online-softmax-spelling
+                     The flash-attention running-max/renormalize update
+                     has ONE spelling: `pallas_attention.
+                     online_softmax_update`, shared by the training
+                     kernels and the paged decode kernel (round 21). A
+                     re-derived copy in a new kernel is exactly how the
+                     max/exp/correction order drifts and the paged
+                     kernel's token-parity bar silently moves — the
+                     degenerate-to-plain-softmax exactness argument
+                     holds for the owner's spelling, not for "a"
+                     spelling. Flags `maximum(..., max(...))` — nested,
+                     or through a name assigned from a `.max(...)` call
+                     in the same function — inside tpukit/ops/ outside
+                     the owner. fused_head_ce's online LOGSUMEXP carries
+                     a reasoned waiver (it streams lse + argmax
+                     tie-break state, a different contract than the
+                     owner's `(m, l, correction, p)`).
 
 Waivers: a site that is legitimately outside a rule carries an inline
 comment on the flagged line —
@@ -81,7 +98,7 @@ SCAN_GLOBS = (
 )
 
 RULES = ("atomic-publish", "retry-io", "sampling-spelling",
-         "collective-spelling")
+         "collective-spelling", "online-softmax-spelling")
 
 # The raw checkpoint I/O helpers that must ride retry_io.
 _RAW_IO_HELPERS = frozenset({
@@ -108,6 +125,16 @@ class Violation:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
 
 
+def _is_max_call(node: ast.AST) -> bool:
+    """True for a `<mod>.max(...)` call (jnp.max / np.max / lax.max —
+    any attribute spelling of a row-max reduction)."""
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "max"
+    )
+
+
 def _waiver_on(lines: list[str], lineno: int) -> tuple[str, str] | None:
     """(rule, reason) of a waiver comment on the given 1-based line."""
     if 1 <= lineno <= len(lines):
@@ -120,7 +147,8 @@ def _waiver_on(lines: list[str], lineno: int) -> tuple[str, str] | None:
 class _Visitor(ast.NodeVisitor):
     def __init__(self, path: Path, rel: str, lines: list[str],
                  owner_funcs: frozenset[str],
-                 wire_collective_owner: bool = False):
+                 wire_collective_owner: bool = False,
+                 ops_kernel_file: bool = False):
         self.path = path
         self.rel = rel
         self.lines = lines
@@ -131,10 +159,18 @@ class _Visitor(ast.NodeVisitor):
         # True only for tpukit/ops/quant_comm.py: the one file allowed to
         # launch the wire collectives directly (collective-spelling)
         self.wire_collective_owner = wire_collective_owner
+        # True for files under tpukit/ops/: the only tree where the
+        # online-softmax-spelling rule applies (kernel code)
+        self.ops_kernel_file = ops_kernel_file
         self.out: list[Violation] = []
         self.func_stack: list[str] = []
         # names bound by `from os import replace/rename` in this file
         self.os_fn_aliases: set[str] = set()
+        # per-scope names assigned from a `.max(...)` call — the
+        # spelled-out form of the online-softmax running max
+        # (`row_max = jnp.max(s); maximum(m, row_max)`); [0] is module
+        # scope, one frame pushed per function
+        self._max_names: list[set[str]] = [set()]
 
     # -- helpers -----------------------------------------------------------
 
@@ -159,10 +195,19 @@ class _Visitor(ast.NodeVisitor):
 
     def visit_FunctionDef(self, node):
         self.func_stack.append(node.name)
+        self._max_names.append(set())
         self.generic_visit(node)
+        self._max_names.pop()
         self.func_stack.pop()
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node: ast.Assign):
+        if _is_max_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._max_names[-1].add(t.id)
+        self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom):
         if node.module == "os":
@@ -230,6 +275,29 @@ class _Visitor(ast.NodeVisitor):
                 "spelling (the round-14 parity guarantee); route through "
                 "_sample_next",
             )
+        # online-softmax-spelling: a hand-rolled flash running-max update
+        # (`maximum(m, max(s))`, nested or via an assigned row-max name)
+        # in kernel code outside online_softmax_update
+        if (
+            self.ops_kernel_file
+            and isinstance(fn, ast.Attribute)
+            and fn.attr == "maximum"
+            and not self._in_function("online_softmax_update")
+            and any(
+                _is_max_call(a)
+                or (isinstance(a, ast.Name) and a.id in self._max_names[-1])
+                for a in node.args
+            )
+        ):
+            self._flag(
+                "online-softmax-spelling", node,
+                "hand-rolled online-softmax running-max update — the "
+                "flash max/renormalize step has ONE spelling, "
+                "pallas_attention.online_softmax_update, so the training "
+                "and paged-decode kernels cannot drift (round 21); call "
+                "the owner (or carry a waiver naming why this "
+                "maximum-of-max is not an online softmax)",
+            )
         # collective-spelling: a raw wire-collective launch (the async
         # start/done ops of the grad/dispatch wire) outside quant_comm.py
         if (
@@ -270,9 +338,12 @@ def lint_file(path: Path, rel: str | None = None) -> list[Violation]:
         owners.update(_RAW_IO_HELPERS)  # a helper may recurse on itself
     if norm.endswith("tpukit/sampling.py"):
         owners.add("_sample_next")
+    if norm.endswith("tpukit/ops/pallas_attention.py"):
+        owners.add("online_softmax_update")  # THE flash max/renorm update
     v = _Visitor(
         path, rel, source.splitlines(), frozenset(owners),
         wire_collective_owner=norm.endswith("tpukit/ops/quant_comm.py"),
+        ops_kernel_file="tpukit/ops/" in norm,
     )
     v.visit(tree)
     return v.out

@@ -42,6 +42,10 @@ Round-20 request tracing adds "trace_event"/"trace" rows (raw span events
 and per-request span trees — rendered in depth by tools/traceview.py),
 per-phase p50/p99 + dispatch-vs-device attribution on serve/fleet
 summaries, and the `--min_trace_complete` completeness-invariant gate.
+Round-21 fused decode adds bench.py's `decode_fused` record (the kernel
+win and the dispatch-amortization win rendered separately) and the
+`--min_decode_speedup` gate on the amortization ratio — the number that
+transfers from CPU loopback, because the kernel cost cancels out of it.
 This tool needs NOTHING but
 the file — no jax import, so it runs anywhere the log was copied to.
 
@@ -49,6 +53,7 @@ Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
                                         [--min_serve_tps 100]
                                         [--min_accept_rate 0.3]
                                         [--min_trace_complete 1.0]
+                                        [--min_decode_speedup 1.0]
 """
 
 from __future__ import annotations
@@ -772,6 +777,44 @@ def summarize(records: list[dict]) -> str:
               + (f"   admit latency hit/cold {hit_s * 1e3:.1f}/"
                  f"{cold_s * 1e3:.1f} ms" if hit_s is not None
                  and cold_s is not None else ""))
+    # round-21 fused-decode bench (ROADMAP #2/#4): the kernel win and the
+    # dispatch-amortization win rendered SEPARATELY — the bench isolates
+    # them so neither can hide behind the other, and the renderer keeps
+    # them apart for the same reason.
+    for r in records:
+        df = r.get("decode_fused")
+        if not isinstance(df, dict):
+            continue
+        w("== fused decode (bench, --fused_decode) ==")
+        if "error" in df:
+            w(f"  ERROR {df['error']}")
+            continue
+        w(f"  stream: {df.get('requests', '?')} requests, "
+          f"{df.get('slots', '?')} slots, page {df.get('page_size', '?')} "
+          f"tokens, window {df.get('window_quanta', '?')} quanta")
+        for name in ("unfused_q1", "fused_q1", "fused_loop"):
+            row = df.get(name)
+            if not row:
+                continue
+            disp = row.get("mean_dispatch_ms_per_quantum")
+            dev = row.get("mean_device_ms_per_quantum")
+            w(f"  {name:<11} {human_count(row.get('tokens_per_sec'))} "
+              f"tokens/s   {row.get('quanta', '?')} quanta / "
+              f"{row.get('decode_steps', '?')} steps"
+              + (f"   dispatch/device {disp:.2f}/{dev:.2f} ms per quantum"
+                 if disp is not None and dev is not None else "")
+              + (f"   trace {df_tc:.2f}" if (df_tc := row.get(
+                    "trace_complete")) is not None else ""))
+        ks, am = df.get("kernel_speedup"), df.get("amortization_speedup")
+        if ks is not None:
+            w(f"  kernel win (fused vs unfused @ quantum=1): {ks:.2f}x"
+              + ("" if ks >= 1.0 else "  (interpret-mode CPU: the kernel "
+                 "runs as a scanned emulation — expected on this backend)"))
+        if am is not None:
+            w(f"  amortization win (on-device loop vs per-step dispatch): "
+              f"{am:.2f}x  <- the gated, backend-transferable number")
+        w("  token parity across all rungs: "
+          + ("exact" if df.get("parity_ok") else "<- MISMATCH"))
     # round-19 fleet bench (ROADMAP #1): the replica scaling curve at
     # equal total devices + the disaggregated-prefill admit-latency
     # comparison, with the CPU-loopback caveat carried in-record.
@@ -999,6 +1042,43 @@ def check_min_overlap_frac(records: list[dict], threshold: float) -> tuple[bool,
     )
 
 
+def check_min_decode_speedup(records: list[dict],
+                             threshold: float) -> tuple[bool, str]:
+    """Fused-decode gate (`--min_decode_speedup`, round 21): the bench
+    `decode_fused` record's AMORTIZATION speedup (on-device while-loop
+    window vs per-step dispatch, same kernel both sides) must be >=
+    `threshold`, with token parity intact across all three rungs. The
+    kernel_speedup stays informational: on CPU loopback the pallas
+    interpret emulation inverts it, but the identical kernel cost cancels
+    out of the amortization ratio, so THAT number transfers to the real
+    backend. A log without the fused record fails — dropping the rung
+    from the bench invocation must not pass the gate vacuously."""
+    for r in records:
+        df = r.get("decode_fused")
+        if not isinstance(df, dict):
+            continue
+        if "error" in df:
+            return False, f"--min_decode_speedup FAIL: rung errored: {df['error']}"
+        if not df.get("parity_ok"):
+            return False, ("--min_decode_speedup FAIL: fused rungs are not "
+                           "token-identical to the unfused engine")
+        am = df.get("amortization_speedup")
+        if am is None:
+            return False, ("--min_decode_speedup FAIL: decode_fused record "
+                           "carries no amortization_speedup")
+        ok = am >= threshold
+        verdict = "OK" if ok else "FAIL"
+        ks = df.get("kernel_speedup")
+        return ok, (
+            f"--min_decode_speedup {verdict}: amortization "
+            f"{am:.2f}x (threshold {threshold:.2f}"
+            + (f"; kernel {ks:.2f}x informational" if ks is not None else "")
+            + ")"
+        )
+    return False, ("--min_decode_speedup: no decode_fused record in the log "
+                   "(did the bench run the fused rungs?)")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("log", help="metrics JSONL written via --metrics_log")
@@ -1038,6 +1118,13 @@ def main(argv=None) -> int:
         "FRACTION (exit 2 below it, or when the log has no overlap "
         "rung) — the overlap-schedule regression gate for CI",
     )
+    ap.add_argument(
+        "--min_decode_speedup", type=float, default=None, metavar="RATIO",
+        help="assert the decode_fused bench record's amortization_speedup "
+        "(on-device scheduler loop vs per-step dispatch) >= RATIO with "
+        "token parity intact (exit 2 below it, or when the log has no "
+        "decode_fused record) — the round-21 fused-decode regression gate",
+    )
     args = ap.parse_args(argv)
     records = load(args.log)
     if not records:
@@ -1067,6 +1154,10 @@ def main(argv=None) -> int:
         rc = rc if ok else 2
     if args.min_overlap_frac is not None:
         ok, msg = check_min_overlap_frac(records, args.min_overlap_frac)
+        print(msg, file=sys.stdout if ok else sys.stderr)
+        rc = rc if ok else 2
+    if args.min_decode_speedup is not None:
+        ok, msg = check_min_decode_speedup(records, args.min_decode_speedup)
         print(msg, file=sys.stdout if ok else sys.stderr)
         rc = rc if ok else 2
     return rc

@@ -358,6 +358,11 @@ def add_serve_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--top_k", type=int, default=0)
     parser.add_argument("--window_steps", type=int, default=32)
+    parser.add_argument("--decode_quantum", type=int, default=4,
+                        help="decode steps per runtime dispatch (and per "
+                        "host sync); with --fused_decode the quantum runs "
+                        "as ONE on-device while_loop — raise it toward "
+                        "--window_steps to amortize dispatch overhead")
     parser.add_argument("--page_size", type=int, default=0,
                         help="paged KV cache: token positions per page "
                         "(must divide every bucket); 0 = the per-slot ring")
@@ -373,6 +378,15 @@ def add_serve_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--prefill_chunk", type=int, default=0,
                         help="chunked-prefill tokens per dispatch (page "
                         "multiple dividing every bucket); 0 = one page")
+    parser.add_argument("--fused_decode", action="store_true",
+                        help="fused paged decode (round 21): T==1 "
+                        "attention runs the fused Pallas paged kernel "
+                        "(block tables dereferenced in-kernel, no "
+                        "per-layer gather) and each quantum runs as one "
+                        "on-device while_loop with early exit "
+                        "(decode.decode_loop_window) — token streams "
+                        "identical, host dispatch amortized across the "
+                        "quantum. Requires --page_size")
     # Speculative decoding (round 17, tpukit/serve/spec.py) — the output
     # distribution is EXACT either way: greedy token-identical to vanilla
     # decode, sampled corrected by rejection sampling.

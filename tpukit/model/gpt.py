@@ -185,6 +185,17 @@ class GPTConfig:
     # dataflow. Strategies without a hand-placed grad wire reject N > 0
     # at validate_config.
     grad_buckets: int = 0
+    # Fused paged decode (round 21, ROADMAP #3 — tpukit/ops/
+    # paged_attention.py). False (default): the paged decode path keeps
+    # its per-layer gather_view + _attend_over_cache trace byte-unchanged.
+    # True: T==1 paged steps route attention through the fused Pallas
+    # kernel — block tables dereferenced inside the kernel, int8 pages
+    # dequantized tile-by-tile in VMEM, single-block flash softmax —
+    # the gathered view's math op-for-op (~1-ULP dot reassociation only;
+    # token streams exactly identical — tests/test_paged_attention.py).
+    # Prefill chunks (T>1) and the pool write-back stay on the shared
+    # unfused spellings either way.
+    fused_decode: bool = False
 
     def __post_init__(self):
         if self.comm_dtype not in ("f32", "bf16", "int8"):
@@ -608,7 +619,8 @@ def _attend_over_cache(layer, cfg: GPTConfig, q, k_cache, v_cache, q_pos):
 
 
 def _apply_attention_paged(layer, cfg: GPTConfig, x, pool_k, pool_v,
-                           scale_k, scale_v, bt, start, write_mask):
+                           scale_k, scale_v, bt, start, write_mask,
+                           mesh=None):
     """Attention for decode over the PAGED cache (round 15, ROADMAP #2):
     the per-row-cursor indirection of the vector path above with one extra
     hop — each row's K/V comes from fixed-size pages dereferenced through
@@ -643,13 +655,29 @@ def _apply_attention_paged(layer, cfg: GPTConfig, x, pool_k, pool_v,
     split = lambda z: z.reshape(batch, t, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
     q, k, v = split(q), split(k), split(v)
 
-    view_k = paged_lib.gather_view(pool_k, scale_k, bt, cfg.compute_dtype)
-    view_v = paged_lib.gather_view(pool_v, scale_v, bt, cfg.compute_dtype)
-    upd = lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (0, s, 0))
-    view_k = jax.vmap(upd)(view_k, k, start)
-    view_v = jax.vmap(upd)(view_v, v, start)
-    q_pos = (start[:, None] + jnp.arange(t))[:, None, :, None]
-    out = _attend_over_cache(layer, cfg, q, view_k, view_v, q_pos)
+    if cfg.fused_decode and t == 1:
+        # round 21: the decode tick skips the materialized gather — the
+        # fused kernel walks the block tables itself (same math op-for-op
+        # as the gathered path; ~1-ULP dot reassociation, exact token
+        # parity — tests/test_paged_attention.py). [B,H,D] out == the
+        # reference transpose+reshape for T==1, so the projection line
+        # is shared.
+        from tpukit.ops import paged_attention as paged_kernel
+
+        attn = paged_kernel.fused_paged_attention(
+            pool_k, pool_v, scale_k, scale_v, bt, start,
+            q[:, :, 0, :], k[:, :, 0, :], v[:, :, 0, :], mesh=mesh,
+        )
+        out = linear(attn.reshape(batch, 1, cfg.inner_dim),
+                     layer["attn"]["out"], cfg.compute_dtype)
+    else:
+        view_k = paged_lib.gather_view(pool_k, scale_k, bt, cfg.compute_dtype)
+        view_v = paged_lib.gather_view(pool_v, scale_v, bt, cfg.compute_dtype)
+        upd = lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (0, s, 0))
+        view_k = jax.vmap(upd)(view_k, k, start)
+        view_v = jax.vmap(upd)(view_v, v, start)
+        q_pos = (start[:, None] + jnp.arange(t))[:, None, :, None]
+        out = _attend_over_cache(layer, cfg, q, view_k, view_v, q_pos)
 
     if t == 1:
         pool_k, scale_k = paged_lib.write_token(
@@ -665,7 +693,7 @@ def _apply_attention_paged(layer, cfg: GPTConfig, x, pool_k, pool_v,
 
 
 def forward_cached(params: Params, cfg: GPTConfig, input_ids, position_ids,
-                   cache, start, write_mask=None):
+                   cache, start, write_mask=None, mesh=None):
     """Forward a chunk of tokens with the KV cache: writes K/V for positions
     `[start, start+T)` and returns `(logits [B, T, padded_vocab], cache)`.
     Prefill with the prompt chunk, then decode with T=1 per step. `start`
@@ -680,7 +708,12 @@ def forward_cached(params: Params, cfg: GPTConfig, input_ids, position_ids,
     (default all-True) gating which rows' K/V reach the pool — the paged
     engine passes the live-slot mask so an inactive lane's re-forward can
     never write a page it no longer owns. The ring path ignores
-    `write_mask` and keeps its original trace byte-unchanged."""
+    `write_mask` and keeps its original trace byte-unchanged.
+
+    `mesh` matters only for the paged path with `cfg.fused_decode`: the
+    fused kernel must run inside shard_map when heads are sharded over a
+    `model` axis (GSPMD cannot partition a pallas_call) — the serve
+    decode step threads its mesh through here."""
     paged = isinstance(cache, dict) and "bt" in cache
     if paged:
         bt = cache["bt"]
@@ -702,7 +735,7 @@ def forward_cached(params: Params, cfg: GPTConfig, input_ids, position_ids,
                 layer, cfg, h, cache["k"][i], cache["v"][i],
                 cache["ks"][i] if quant else None,
                 cache["vs"][i] if quant else None,
-                bt, start, write_mask,
+                bt, start, write_mask, mesh=mesh,
             )
             new_ks.append(ks_c)
             new_vs.append(vs_c)

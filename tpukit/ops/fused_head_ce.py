@@ -97,7 +97,7 @@ def _fwd_kernel(tgt_ref, h_ref, w_ref, lse_ref, tgtl_ref, best_ref,
     # online logsumexp over vocab tiles
     m_prev = m_scr[:, :1]
     row_max = jnp.max(logits, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, row_max)
+    m_new = jnp.maximum(m_prev, row_max)  # lint: allow(online-softmax-spelling): online LOGSUMEXP for the CE loss — streams lse + argmax tie-break state, not the owner's (m, l, correction, p) contract
     corr = jnp.exp(m_prev - m_new)
     l_new = l_scr[:, :1] * corr + jnp.sum(jnp.exp(logits - m_new), axis=1, keepdims=True)
     m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)

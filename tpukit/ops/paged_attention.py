@@ -1,0 +1,280 @@
+"""Fused paged-attention decode kernel (Pallas TPU, round 21 — ROADMAP #3).
+
+The unfused paged decode path (`gpt._apply_attention_paged`) pays one
+XLA gather PER LAYER to materialize the full `[N, H, MP*P, D]` view of
+every slot's pages before attention — and int8 pools dequantize that
+whole view up front, paying the f32 expansion in HBM for positions the
+causal window then masks away. This kernel removes the materialized view
+entirely: the block tables are dereferenced INSIDE the kernel (scalar-
+prefetch SMEM reads feeding VMEM page-tile copies), int8 pages
+dequantize tile-by-tile in VMEM via the quant_comm 256-element block
+layout, and the softmax/value mix runs flash-style over the assembled
+window — the only HBM traffic is the page pool itself, once per head.
+
+Decode-only by design: one query token per slot (the serve decode tick),
+no VJP — training attention is `pallas_attention.py`'s job. The pool
+WRITE-BACK also stays outside (the shared `paged.write_token` spelling):
+the kernel is a pure read, which is what keeps the TP comm audit the
+unfused plan unchanged (see `fused_paged_attention`).
+
+Exactness (the parity bar, tests/test_paged_attention.py): the kernel is
+`gpt._attend_over_cache` over the gathered view OPERATION-FOR-OPERATION
+— same dots on the same operands in the same dtypes, algebraically
+identical softmax (below). The one residual is reassociation, not math:
+XLA compiles the kernel's per-(head, slot) dots inside the grid program
+(interpret mode scans the grid; Mosaic tiles it) while the reference
+einsum is a standalone batched GEMM, and the two accumulation orders
+differ at the ~1-ULP level (measured max 5e-7 on XLA:CPU f32 at test
+shapes). The tests therefore pin what is actually invariant: attention
+outputs within a few ULPs, and TOKEN streams (greedy and fixed-seed
+sampled, through the full engine) exactly identical. Two deliberate
+choices keep the math itself identical:
+
+  - ONE online-softmax block over the whole window. The decode window is
+    statically bounded (`MP * P` positions — pages_per_slot is a config
+    constant), so the flash recurrence degenerates to a single call of
+    the shared `online_softmax_update` helper from `-inf`/`0` state:
+    `m = maximum(-inf, max(s))` IS the plain softmax max and
+    `l = 0 * exp(-inf) + sum(p)` IS the plain normalizer, exactly.
+    A page-blocked multi-call recurrence would trade that exactness
+    for nothing here — the whole window already fits VMEM.
+  - Divide BEFORE the value dot: `o = (p / l) @ v`, matching
+    `jax.nn.softmax(...).astype(cdt) @ v` operation-for-operation (the
+    reference casts probabilities to the compute dtype before the mix,
+    and so does this kernel).
+
+int8 pages dequantize with the exact `quant_comm.dequantize_blocks`
+arithmetic (f32 cast, per-256-block scale multiply) per page tile, so
+the fused int8 path is elementwise-identical to gather_view's dequant —
+the existing >=90% token-agreement gate transfers unchanged.
+
+Grid is `(H, N)` with slots innermost: the per-head pool slab
+`[NP, 1, P, D]` stays VMEM-resident while every slot's window is
+assembled against it — the pool is fetched H times total, not N*H.
+Every test runs on this container via `interpret=_interpret()` (the
+pallas_attention convention); the VMEM footprint of the head slab is
+asserted with a named error (TPUKIT_PAGED_VMEM_MB) instead of a Mosaic
+OOM.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from tpukit.ops import quant_comm
+from tpukit.ops.pallas_attention import (
+    NEG_INF,
+    _interpret,
+    online_softmax_update,
+    tpu_compiler_params,
+)
+
+# The per-head VMEM working set (both pool slabs + scale rows + the two
+# assembled windows) is bounded with a NAMED error instead of a Mosaic
+# OOM — the moe_gemm._MAX_VMEM_EXPERTS discipline. Sweepable.
+_PAGED_VMEM_BYTES = (
+    int(os.environ.get("TPUKIT_PAGED_VMEM_MB", "64")) * 1024 * 1024
+)
+
+
+def _check_vmem(num_pages, page, head_dim, mp, pool_itemsize, quant, cdt):
+    window = mp * page * head_dim * jnp.dtype(cdt).itemsize
+    slab = num_pages * page * head_dim * pool_itemsize
+    total = 2 * slab + 2 * window
+    if quant:
+        nb = (page * head_dim) // quant_comm.DEFAULT_BLOCK
+        total += 2 * num_pages * nb * 4
+    if total > _PAGED_VMEM_BYTES:
+        raise ValueError(
+            f"fused paged attention keeps one head's K+V pool slab VMEM-"
+            f"resident: {num_pages} pages x {page} x {head_dim} needs "
+            f"{total // (1024 * 1024)} MiB, over the "
+            f"{_PAGED_VMEM_BYTES // (1024 * 1024)} MiB budget "
+            f"(TPUKIT_PAGED_VMEM_MB) — shrink the pool or use the "
+            f"unfused path (fused_decode=False)"
+        )
+
+
+def _paged_kernel(bt_ref, start_ref, *refs, page, mp, head_dim, quant,
+                  scale):
+    """One (head, slot) step: assemble the slot's `[MP*P, D]` K/V window
+    from its block-table pages (SMEM-prefetched ids -> VMEM tile copies,
+    dequantizing int8 tiles in place), insert the fresh K/V at the
+    cursor, and run the single-block flash softmax + value mix."""
+    if quant:
+        (pool_k_ref, pool_v_ref, sk_ref, sv_ref, q_ref, kn_ref, vn_ref,
+         o_ref, k_win, v_win) = refs
+    else:
+        (pool_k_ref, pool_v_ref, q_ref, kn_ref, vn_ref, o_ref, k_win,
+         v_win) = refs
+    n = pl.program_id(1)
+    w = mp * page
+    st = start_ref[n]
+
+    def load_tile(pool_ref, scale_ref, pid):
+        tile = pool_ref[pl.ds(pid, 1), 0]  # (1, P, D), pool storage dtype
+        if quant:
+            nb = (page * head_dim) // quant_comm.DEFAULT_BLOCK
+            srow = scale_ref[pl.ds(pid, 1), 0]  # (1, nb) f32
+            # dequantize_blocks verbatim per (page, head) row: f32 cast,
+            # per-256-element-block scale multiply — elementwise-identical
+            # to the gathered view's dequant
+            xb = tile.astype(jnp.float32).reshape(nb, quant_comm.DEFAULT_BLOCK)
+            tile = (xb * srow.reshape(nb, 1)).reshape(1, page, head_dim)
+        return tile.reshape(page, head_dim).astype(k_win.dtype)
+
+    for j in range(mp):  # MP is static and small: unrolled page walk
+        pid = bt_ref[n, j]
+        k_win[pl.ds(j * page, page), :] = load_tile(
+            pool_k_ref, sk_ref if quant else None, pid
+        )
+        v_win[pl.ds(j * page, page), :] = load_tile(
+            pool_v_ref, sv_ref if quant else None, pid
+        )
+
+    # fresh-token insert at the cursor — the same clamp semantics as the
+    # unfused path's dynamic_update_slice (start is < W for every lane
+    # the engine dispatches; the clamp only guards degenerate inputs)
+    idx = jnp.minimum(st, w - 1)
+    k_win[pl.ds(idx, 1), :] = kn_ref[0]
+    v_win[pl.ds(idx, 1), :] = vn_ref[0]
+
+    # scores in the COMPUTE dtype (no preferred_element_type — the
+    # reference einsum's accumulation), scale + causal mask applied in
+    # the same dtype/order as _attend_over_cache, THEN the f32 cast
+    s = jax.lax.dot_general(
+        q_ref[0], k_win[...],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+    ) * scale  # (1, W)
+    key_pos = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+    s = jnp.where(key_pos <= st, s, jnp.asarray(NEG_INF, s.dtype))
+    s32 = s.astype(jnp.float32)
+
+    # ONE shared-helper call over the full window: degenerate flash ==
+    # plain softmax exactly (module docstring); divide-before-dot matches
+    # softmax(...).astype(cdt) @ v operation-for-operation
+    m0 = jnp.full((1, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((1, 1), jnp.float32)
+    _, l, _, p = online_softmax_update(m0, l0, s32)
+    probs = (p / l).astype(v_win.dtype)
+    o = jax.lax.dot_general(
+        probs, v_win[...],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+    )
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def paged_attend(pool_k, pool_v, scale_k, scale_v, bt, start, q, k_new,
+                 v_new):
+    """Fused paged decode attention over one layer's pools (meshless /
+    per-shard form — see `fused_paged_attention` for the TP wrapper).
+
+    pool_k/pool_v: `[NP, H, P, D]` page pools (f32/bf16 storage, or int8
+    with `scale_k`/`scale_v` `[NP, H, nb]` f32 sidecars; pass None scales
+    for unquantized pools); bt `[N, MP]` int32 block tables; start `[N]`
+    int32 cursors; q/k_new/v_new `[N, H, D]` in the compute dtype (the
+    decode tick's single token per slot). Returns `[N, H, D]` attention
+    outputs — pre-projection, `_attend_over_cache` on the gathered view
+    op-for-op (module docstring: identical math, ~1-ULP dot
+    reassociation, exact token parity)."""
+    num_pages, heads, page, head_dim = pool_k.shape
+    n, mp = bt.shape
+    quant = scale_k is not None
+    cdt = q.dtype
+    if quant and (page * head_dim) % quant_comm.DEFAULT_BLOCK:
+        raise ValueError(
+            f"int8 pages need page_size x head_dim ({page} x {head_dim}) "
+            f"to tile into {quant_comm.DEFAULT_BLOCK}-element quant blocks "
+            f"(paged.validate_kv_layout enforces this upstream)"
+        )
+    _check_vmem(num_pages, page, head_dim, mp, pool_k.dtype.itemsize,
+                quant, cdt)
+    w = mp * page
+
+    kernel = functools.partial(
+        _paged_kernel, page=page, mp=mp, head_dim=head_dim, quant=quant,
+        scale=1.0 / head_dim**0.5,
+    )
+    # per-head pool slab, constant across the inner slot axis — fetched
+    # into VMEM once per head and reused for every slot's window
+    slab = pl.BlockSpec((num_pages, 1, page, head_dim),
+                        lambda h, n, *_: (0, h, 0, 0))
+    vec = pl.BlockSpec((1, 1, head_dim), lambda h, n, *_: (n, h, 0))
+    in_specs = [slab, slab]
+    operands = [pool_k, pool_v]
+    if quant:
+        nb = (page * head_dim) // quant_comm.DEFAULT_BLOCK
+        srow = pl.BlockSpec((num_pages, 1, nb), lambda h, n, *_: (0, h, 0))
+        in_specs += [srow, srow]
+        operands += [scale_k, scale_v]
+    in_specs += [vec, vec, vec]
+    operands += [q, k_new, v_new]
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # bt + start ride SMEM, read per slot
+            grid=(heads, n),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, head_dim),
+                                   lambda h, n, *_: (n, h, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((w, head_dim), cdt),
+                pltpu.VMEM((w, head_dim), cdt),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, heads, head_dim), cdt),
+        compiler_params=tpu_compiler_params("parallel", "arbitrary"),
+        interpret=_interpret(),
+    )(bt, start, *operands)
+
+
+def fused_paged_attention(pool_k, pool_v, scale_k, scale_v, bt, start, q,
+                          k_new, v_new, mesh=None):
+    """`paged_attend` under the serving mesh. GSPMD cannot partition a
+    pallas_call — left alone it would replicate the kernel and bolt
+    resharding collectives around it, breaking the plan-exactness bar —
+    so under a model axis the kernel runs inside shard_map at exactly the
+    pools' serving layout: heads sharded over `model`, block tables and
+    cursors replicated, zero collectives inside the body. The per-step
+    comm therefore stays the unfused `decode_step_comm(paged=True)`
+    closed form unchanged (the fused HLO audit, tests)."""
+    if mesh is None or mesh.shape.get("model", 1) <= 1:
+        return paged_attend(pool_k, pool_v, scale_k, scale_v, bt, start,
+                            q, k_new, v_new)
+    m = mesh.shape["model"]
+    heads = pool_k.shape[1]
+    if heads % m:
+        raise ValueError(
+            f"fused paged attention shards heads over the model axis: "
+            f"heads={heads} must divide model={m} (the paged serving grid "
+            f"picker guarantees this)"
+        )
+    from tpukit.compat import shard_map
+
+    pool_spec = P(None, "model", None, None)
+    head_spec = P(None, "model", None)
+    if scale_k is None:
+        fn = lambda pk, pv, b, s, qq, kn, vn: paged_attend(
+            pk, pv, None, None, b, s, qq, kn, vn
+        )
+        return shard_map(
+            fn, mesh=mesh,
+            in_specs=(pool_spec, pool_spec, P(), P(), head_spec,
+                      head_spec, head_spec),
+            out_specs=head_spec, check_rep=False,
+        )(pool_k, pool_v, bt, start, q, k_new, v_new)
+    scale_spec = P(None, "model", None)
+    return shard_map(
+        paged_attend, mesh=mesh,
+        in_specs=(pool_spec, pool_spec, scale_spec, scale_spec, P(), P(),
+                  head_spec, head_spec, head_spec),
+        out_specs=head_spec, check_rep=False,
+    )(pool_k, pool_v, scale_k, scale_v, bt, start, q, k_new, v_new)

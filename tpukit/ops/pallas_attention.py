@@ -86,6 +86,28 @@ def tpu_compiler_params(*dimension_semantics: str):
     )
 
 
+def online_softmax_update(m_prev, l_prev, s):
+    """THE one spelling of the flash-attention running-max/renormalize
+    update, shared by the training kernels here and the paged decode
+    kernel (tpukit/ops/paged_attention.py) so the two cannot drift
+    (lint_invariants rule `online-softmax-spelling` pins every other
+    `maximum(m, max(s))` occurrence to this owner).
+
+    `m_prev`/`l_prev`: `[rows, 1]` f32 running max / normalizer (init
+    `-inf` / `0`); `s`: `[rows, cols]` f32 scores for the incoming block.
+    Returns `(m_new, l_new, correction, p)` where `correction` rescales
+    any accumulator built under `m_prev` and `p = exp(s - m_new)` is the
+    block's unnormalized probabilities. A single call over the FULL score
+    row degenerates to the plain softmax exactly: `maximum(-inf, max(s))`
+    is the true max and `l_new = 0 * exp(-inf) + sum(p) = sum(p)` — the
+    exactness argument the paged kernel's bit-parity bar rides."""
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    correction = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+    return m_new, l_new, correction, p
+
+
 def _plan(seq: int) -> tuple[int, int]:
     """(block, seq_pad) for a given sequence length. Mosaic requires the
     score-block edge and the padded sequence to be lane-aligned: for
@@ -171,10 +193,7 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc
 
         m_prev = m_scr[:, :1]  # (BQ, 1)
         l_prev = l_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        correction = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
+        m_new, l_new, correction, p = online_softmax_update(m_prev, l_prev, s)
         acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
             p.astype(v_blk.dtype),
             v_blk,

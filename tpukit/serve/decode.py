@@ -106,7 +106,7 @@ def _advance(params, cfg, buf, cache, cursors, active, limits, keys,
         # because each slot exclusively owns its full-width ring rows.
         logits, cache = gpt.forward_cached(
             params, cfg, tok, read[:, None].astype(jnp.int32), cache, read,
-            write_mask=active,
+            write_mask=active, mesh=mesh,
         )
     else:
         logits, cache = gpt.forward_cached(
@@ -347,6 +347,72 @@ def decode_loop(params, cfg: gpt.GPTConfig, buf, prompt_lens,
         cond, body, (buf, cache, cursors, active)
     )
     return buf, cursors
+
+
+# No donation — see the decode_step note (persistent-cache deserialization
+# of donated executables mis-aliases on this jaxlib).
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "eos_id", "temperature", "top_k", "mesh"),
+)
+def decode_loop_window(params, cfg: gpt.GPTConfig, buf, cache, cursors,
+                       active, limits, keys, pages_held, max_ticks,
+                       stop_when_freed, eos_id: int,
+                       temperature: float = 0.0, top_k: int = 0, mesh=None):
+    """On-device scheduler window (round 21, ROADMAP #3): run the decode
+    tick in a `lax.while_loop` for up to `max_ticks` quanta WITHOUT any
+    host sync — cursors, EOS/limit flags, and the freed-page account all
+    live in the carry, so the whole window costs ONE runtime dispatch.
+    PR 16's trace attribution priced the per-quantum host overhead at
+    ~0.3ms dispatch against ~0.7ms device work; this loop amortizes that
+    dispatch cost across the window instead of paying it every quantum.
+
+    The loop exits early — handing control back to the host scheduler
+    before the window is spent — when continuing would waste device time
+    or starve admission:
+
+      - every lane is done (`~any(active)`): nothing left to decode;
+      - `freed >= stop_when_freed`: lanes that finished mid-window have
+        released enough pages (`pages_held [N]` int32, each lane's
+        page count, summed as lanes flip inactive) to admit the
+        scheduler's head-of-queue request — the host should evict and
+        admit NOW rather than let capacity idle for the rest of the
+        window. Pass `1 << 30` when the queue is empty.
+
+    `max_ticks` and `stop_when_freed` are TRACED int32 scalars: one
+    compile serves every window size and page target. Returns
+    `(buf, cache, cursors, active, ticks, freed)` — `ticks` is how many
+    ticks actually ran (the engine's step accounting fetches it with the
+    window-boundary sync, never mid-window).
+
+    Token parity is free: the body is `_advance` — frozen lanes tick as
+    no-ops and each lane's sampling folds its own cursor — so the streams
+    are identical for ANY (max_ticks, early-exit) schedule; only the host
+    sync cadence changes (tests/test_paged_attention.py pins loop-vs-
+    repeated-`decode_step` equality under early exit). The comm audit is
+    unaffected for the same reason the quantum was: the while body
+    appears ONCE in the compiled HLO, so `decode_step_comm` stays the
+    per-step expectation at any window (the `sched_loop` hlolint world).
+    """
+
+    def cond(carry):
+        _, _, _, active, ticks, freed = carry
+        return jnp.any(active) & (ticks < max_ticks) & (freed < stop_when_freed)
+
+    def body(carry):
+        buf, cache, cursors, active, ticks, freed = carry
+        buf, cache, cursors, new_active = _advance(
+            params, cfg, buf, cache, cursors, active, limits, keys,
+            eos_id, temperature, top_k, mesh
+        )
+        just_done = active & ~new_active
+        freed = freed + jnp.sum(jnp.where(just_done, pages_held, 0))
+        return buf, cache, cursors, new_active, ticks + 1, freed
+
+    zero = jnp.zeros((), jnp.int32)
+    return jax.lax.while_loop(
+        cond, body, (buf, cache, cursors, active, zero, zero)
+    )
 
 
 def decode_step_comm(cfg: gpt.GPTConfig, mesh, slots: int, top_k: int = 0,

@@ -184,6 +184,18 @@ class ServeConfig:
     # Longest n-gram the self-speculation proposer matches (it falls back
     # through shorter suffixes down to 1).
     ngram_max: int = 3
+    # Fused paged decode + on-device scheduler loop (round 21, ROADMAP
+    # #3). False (default): byte-identical engine behavior — the unfused
+    # per-quantum decode_step. True (paged only): T==1 attention runs
+    # the fused Pallas kernel (tpukit/ops/paged_attention.py — block
+    # tables dereferenced in-kernel, no per-layer gather) and each
+    # quantum dispatches decode.decode_loop_window — scheduler state
+    # (cursors, EOS/limit flags, the freed-page account) lives on device
+    # across up to `decode_quantum` ticks with early exit when every
+    # lane finishes or enough pages free to admit the head-of-queue
+    # request. Token streams are identical either way; only the kernel
+    # and the host sync cadence change.
+    fused_decode: bool = False
 
     def __post_init__(self):
         if self.draft not in ("", "ngram", "model"):
@@ -236,6 +248,12 @@ class ServeConfig:
         if self.page_size < 0:
             raise ValueError(f"page_size={self.page_size} must be >= 0")
         if self.page_size == 0:
+            if self.fused_decode:
+                raise ValueError(
+                    "fused_decode=True requires the paged cache "
+                    "(page_size > 0) — the fused kernel walks block "
+                    "tables; the ring path keeps its round-14 trace"
+                )
             if self.kv_dtype != "f32":
                 raise ValueError(
                     f"kv_dtype={self.kv_dtype!r} requires the paged cache "
@@ -416,7 +434,10 @@ class ServeEngine:
                 f"{serve.draft!r} — set draft='model' to use them"
             )
         self.params = params
-        self.cfg = cfg
+        # round 21: --fused_decode flips the MODEL flag too — the decode
+        # step's T==1 paged attention routes through the fused kernel.
+        # Off keeps cfg untouched, so traces are byte-identical.
+        self.cfg = cfg.replace(fused_decode=True) if serve.fused_decode else cfg
         self.serve = serve
         self.eos_id = int(eos_id)
         self.mesh = mesh
@@ -434,6 +455,10 @@ class ServeEngine:
         # (asserted in tests/test_trace.py).
         self.tracer = tracer
         self._pending_quantum = None  # dispatch half of the quantum event
+        # fused windows (round 21): the device tick counter of the last
+        # decode_loop_window dispatch, fetched at the window-boundary sync
+        # (the loop may exit early, so the host can't assume the quantum)
+        self._pending_ticks = None
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         # lax.top_k rejects k beyond the logits width — clamp like generate()
@@ -789,6 +814,51 @@ class ServeEngine:
             self._refresh_bt()
         tr = self.tracer
         t0 = tr.now() if tr is not None else 0.0
+        if self.serve.fused_decode:
+            # round 21: the whole quantum runs as ONE on-device
+            # while_loop dispatch (decode.decode_loop_window) — cursors,
+            # EOS/limit flags and the freed-page account advance on
+            # device, and the loop hands back early when every lane is
+            # done or finished lanes have freed enough pages to admit
+            # the head of the queue (its worst-case footprint; 1<<30
+            # disables the exit when nothing is waiting — a spurious
+            # early exit only costs one extra host round-trip, so the
+            # conservative target is safe). The tick count is a DEVICE
+            # scalar; `_sync_evict` fetches it with the cursors and
+            # accounts steps there — the host never assumes the quantum
+            # ran to completion.
+            ph = np.zeros((self.serve.slots,), np.int32)
+            for s, lane in self._lanes.items():
+                if lane.phase == "decode":
+                    ph[s] = len(lane.pages)
+            if self._pending:
+                head = self._pending[0]
+                need = -(-min(len(head.ids) + head.max_new_tokens,
+                              self.serve.width) // self.serve.page_size)
+            else:
+                need = 1 << 30
+            with self.spans.span("decode"):
+                (self.buf, self.cache, self.cursors, self.active, ticks,
+                 _) = serve_decode.decode_loop_window(
+                    self.params, self.cfg, self.buf, self.cache,
+                    self.cursors, self.active, self.limits, self.keys,
+                    self._place(ph, self._slot_spec),
+                    self._place(np.asarray(self.serve.decode_quantum,
+                                           np.int32), P()),
+                    self._place(np.asarray(need, np.int32), P()),
+                    self.eos_id, float(self.serve.temperature),
+                    self._top_k, self.mesh,
+                )
+            self._pending_ticks = ticks
+            if tr is not None:
+                # steps is filled at sync, once the device count lands
+                self._pending_quantum = dict(
+                    t0=t0, t1=tr.now(), steps=0,
+                    lanes=[trace_id(l.req)
+                           for s, l in sorted(self._lanes.items())
+                           if l.phase == "decode"],
+                )
+            return
         with self.spans.span("decode"):
             self.buf, self.cache, self.cursors, self.active = serve_decode.decode_step(
                 self.params, self.cfg, self.buf, self.cache, self.cursors,
@@ -905,6 +975,19 @@ class ServeEngine:
                 cur, act, dlen, acc, napp = map(np.asarray, jax.device_get(
                     (self.cursors, self.active, dlen, acc, napp)))
                 self._pending_spec = (live, dlen, acc, napp)
+            elif self._pending_ticks is not None:
+                # fused window (round 21): the actual tick count rides
+                # the same D2H round-trip as the cursors — the loop may
+                # have exited early, so steps are accounted HERE, from
+                # the device's answer, never assumed from the quantum
+                cur, act, ticks = map(np.asarray, jax.device_get(
+                    (self.cursors, self.active, self._pending_ticks)))
+                self._pending_ticks = None
+                ran = int(ticks)
+                self.steps += ran
+                self._win["steps"] += ran
+                if self._pending_quantum is not None:
+                    self._pending_quantum["steps"] = ran
             else:
                 cur, act = map(np.asarray,
                                jax.device_get((self.cursors, self.active)))

@@ -632,6 +632,72 @@ def bench_serve_trace_overhead(cfg, n_dev, requests=32, slots=8, max_new=16):
     }
 
 
+def bench_metrics_overhead(cfg, n_dev, requests=32, slots=8, max_new=16):
+    """Metrics-plane overhead on the serving engine (round 22): the SAME
+    seeded stream served twice, registry off (--no_metrics) then on,
+    after a warm pass that absorbs compiles. The metrics plane is a pure
+    observer — counters/gauges/histograms DERIVED from completions the
+    engine computes anyway — so the acceptance bar is the round-20
+    discipline verbatim: tokens/s delta under 1% AND bit-identical
+    output tokens per request. The atomic snapshot publish + merge (the
+    only new I/O) is timed separately so dir-publish cost can't hide
+    inside the throughput delta."""
+    import tempfile
+    import time
+
+    import jax
+
+    from tpukit.data import get_tokenizer
+    from tpukit.model import init_params
+    from tpukit.obs import MetricRegistry, merge_snapshot_dir, publish_snapshot
+    from tpukit.serve import ServeConfig, ServeEngine, synthetic_request_stream
+
+    tokenizer = get_tokenizer()
+    tokenizer.pad_token_id = 2
+    cfg = cfg.replace(vocab_size=tokenizer.vocab_size)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    buckets = lengths = (8, 16, 24, 32)
+    eos = int(tokenizer.eos_token_id)
+    stream = list(synthetic_request_stream(
+        tokenizer, requests, seed=0, max_new_tokens=max_new,
+        buckets=buckets, lengths=lengths,
+    ))
+    serve = ServeConfig(slots=slots, buckets=buckets, max_new_tokens=max_new,
+                        window_steps=10**9)
+
+    def run(with_metrics: bool):
+        metrics = MetricRegistry() if with_metrics else None
+        eng = ServeEngine(params, cfg, serve, eos_id=eos, metrics=metrics)
+        t0 = time.perf_counter()
+        comps = eng.run(list(stream), max_wall_s=900)
+        wall = time.perf_counter() - t0
+        gen = sum(c.generated for c in comps)
+        toks = {c.rid: [int(x) for x in np.asarray(c.ids)] for c in comps}
+        return gen / wall, toks, metrics
+
+    run(False)  # warm: bucket prefills + the decode step compile
+    tps_off, toks_off, _ = run(False)
+    tps_on, toks_on, metrics = run(True)
+    snap = metrics.snapshot()
+    series = (len(snap["counters"]) + len(snap["gauges"])
+              + len(snap["hists"]))
+    with tempfile.TemporaryDirectory() as d:
+        t0 = time.perf_counter()
+        publish_snapshot(d, 0, metrics, time_s=time.time())
+        merge_snapshot_dir(d)
+        publish_s = time.perf_counter() - t0
+    return {
+        "requests": requests, "slots": slots, "max_new_tokens": max_new,
+        "tokens_per_sec_off": round(tps_off, 1),
+        "tokens_per_sec_on": round(tps_on, 1),
+        "overhead_frac": round((tps_off - tps_on) / tps_off, 4)
+        if tps_off else None,
+        "tokens_bit_identical": toks_off == toks_on,
+        "series": series,
+        "snapshot_publish_s": round(publish_s, 6),
+    }
+
+
 def bench_serve_dispatch_attribution(cfg, n_dev, requests=32, slots=8,
                                      max_new=16):
     """Per-quantum dispatch-vs-device attribution on a traced serving run
@@ -1844,6 +1910,15 @@ def main(argv=None):
         obs_overhead = {}
     obs_overhead["serving"] = serving_rung
 
+    # Round-22 metrics-plane rung of the same story: the registry on vs
+    # --no_metrics on the same seeded stream — tokens/s delta (<1% bar),
+    # bit-identical tokens, and the snapshot-publish wall timed apart.
+    try:
+        metrics_overhead_rec = bench_metrics_overhead(cfg, n_dev)
+    except Exception as exc:
+        metrics_overhead_rec = {"error": repr(exc)}
+        print(f"metrics overhead probe failed: {exc!r}", file=sys.stderr)
+
     # Ladder rungs (VERDICT r4 #1): single-chip measurements of the
     # BASELINE configs 2-5 shapes at head_dim=64 — GPT-small/medium full,
     # GPT-large/XL as the 16-layer stage slices DESIGN.md §2 profiles.
@@ -1892,6 +1967,7 @@ def main(argv=None):
         "host_pipeline_error": host_pipeline_err,
         "obs_overhead": obs_overhead,
         "obs_overhead_error": obs_overhead_err,
+        "metrics_overhead": metrics_overhead_rec,
         "ladder": ladder,
         "chips": n_dev,
         "device": jax.devices()[0].device_kind,

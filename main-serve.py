@@ -188,7 +188,13 @@ def main(argv=None):
     from tpukit.data import get_tokenizer
     from tpukit.mesh import create_mesh, initialize_runtime, is_process_zero
     from tpukit.model import GPTConfig
-    from tpukit.obs import FlightRecorder, StepLogger, TraceRecorder
+    from tpukit.obs import (
+        FlightRecorder,
+        MetricRegistry,
+        StepLogger,
+        TraceRecorder,
+        parse_slo,
+    )
     from tpukit.serve import ServeConfig, ServeEngine, synthetic_request_stream
     from tpukit.shardings import DataParallel, SingleDevice, TensorParallel
     from tpukit.train import TrainState, create_train_state, make_optimizer
@@ -391,9 +397,15 @@ def main(argv=None):
     # token-bit-identical on/off by tests/test_trace.py.
     tracer = (None if flags.no_trace
               else TraceRecorder(capacity=flags.trace_capacity))
+    # Metrics plane (round 22): on by default; --slo parses NOW so a
+    # typo'd objective fails the launch, not silently never gates
+    # (chaos-grammar discipline; SloSpecError is a clean startup error).
+    metrics = None if flags.no_metrics else MetricRegistry()
+    slo = parse_slo(flags.slo) if flags.slo else None
     engine = ServeEngine(params, cfg, serve, eos_id=int(tokenizer.eos_token_id),
                          mesh=mesh, logger=logger, recorder=recorder,
-                         tracer=tracer,
+                         tracer=tracer, metrics=metrics, slo=slo,
+                         metrics_dir=flags.metrics_dir or None,
                          draft_params=draft_params, draft_cfg=draft_cfg)
     requests = synthetic_request_stream(
         tokenizer, flags.requests, seed=flags.seed,
@@ -441,6 +453,17 @@ def main(argv=None):
                               for k, v in p50p.items() if v)
                   + (f" (view: python tools/traceview.py {flags.metrics_log})"
                      if flags.metrics_log else ""))
+        if s.get("trace_dropped"):
+            print(f"WARNING: {s['trace_dropped']} trace events evicted "
+                  f"(ring saturated) — phase aggregates above are built "
+                  f"from an incomplete history; grow --trace_capacity")
+        if s.get("slo_overall_compliance") is not None:
+            print(f"SLO compliance {100 * s['slo_overall_compliance']:.2f}% "
+                  f"(worst target, cumulative) for --slo {flags.slo!r}")
+        if flags.metrics_dir:
+            print(f"metric snapshots -> {flags.metrics_dir} "
+                  f"(live: python tools/top.py {flags.metrics_log or '-'} "
+                  f"--metrics_dir {flags.metrics_dir})")
         for c in completions[:3]:
             print(f"  [{c.rid}] " + tokenizer.decode(
                 np.asarray(c.ids), skip_special_tokens=True))
@@ -468,7 +491,13 @@ def _run_fleet(flags, cfg, tokenizer, buckets) -> int:
 
     from tpukit import checkpoint as ckpt_lib
     from tpukit.mesh import is_process_zero
-    from tpukit.obs import FlightRecorder, StepLogger, TraceRecorder
+    from tpukit.obs import (
+        FlightRecorder,
+        MetricRegistry,
+        StepLogger,
+        TraceRecorder,
+        parse_slo,
+    )
     from tpukit.serve import (
         FleetConfig,
         FleetRouter,
@@ -552,9 +581,16 @@ def _run_fleet(flags, cfg, tokenizer, buckets) -> int:
     # span events land in per-replica rings and merge into one event stream.
     tracer = (None if flags.no_trace
               else TraceRecorder(capacity=flags.trace_capacity))
+    # One shared MetricRegistry too (round 22): replica engines observe
+    # replica-labeled series into it; the router accounts the declared
+    # --slo fleet-wide and owns the --metrics_dir snapshot publish/merge.
+    metrics = None if flags.no_metrics else MetricRegistry()
+    slo = parse_slo(flags.slo) if flags.slo else None
     router = FleetRouter(params_host, cfg, serve, fleet,
                          eos_id=int(tokenizer.eos_token_id),
-                         logger=logger, recorder=recorder, tracer=tracer)
+                         logger=logger, recorder=recorder, tracer=tracer,
+                         metrics=metrics, slo=slo,
+                         metrics_dir=flags.metrics_dir or None)
     if path is not None:
         rec = dict(kind="ckpt_restore", params_only=True, fleet=True,
                    checkpoint=str(path), replicas=flags.replicas,
@@ -610,6 +646,14 @@ def _run_fleet(flags, cfg, tokenizer, buckets) -> int:
                   f"span trees; phase p50 (ms) "
                   + "  ".join(f"{k} {1e3 * v:.1f}"
                               for k, v in p50p.items() if v))
+        if s.get("trace_dropped"):
+            print(f"  WARNING: {s['trace_dropped']} trace events evicted "
+                  f"(per replica {s.get('trace_dropped_by_replica')}) — "
+                  f"grow --trace_capacity")
+        if s.get("slo_overall_compliance") is not None:
+            print(f"  SLO compliance "
+                  f"{100 * s['slo_overall_compliance']:.2f}% (worst "
+                  f"target, cumulative) for --slo {flags.slo!r}")
         if flags.metrics_log:
             print(f"fleet telemetry -> {flags.metrics_log} "
                   f"(render: python tools/report.py {flags.metrics_log})")

@@ -1,0 +1,644 @@
+"""Fleet metrics plane (tpukit/obs/metrics, round 22).
+
+Contracts pinned here:
+  - histograms share ONE log-spaced edge table, so merge is bucket-wise
+    sum: EXACT, associative, commutative — shuffled shard orders and
+    re-parenthesised merges produce identical bucket tables;
+  - quantile estimates respect the proven relative-error bound
+    `sqrt(GROWTH)-1 ~ 4.4%` against exact nearest-rank on adversarial
+    distributions (bimodal, heavy-tail, single-bucket, log-uniform);
+    underflow/overflow samples clamp to the exact tracked min/max;
+  - registry snapshots round-trip losslessly; snapshot FILES follow the
+    heartbeat discipline: atomic publish, torn files skip-and-count,
+    stale incarnations (process >= process_count) are excluded;
+  - `--slo` parsing fails fast on malformed specs (SloSpecError), and
+    the accountant's compliance / error-budget burn arithmetic is exact;
+    `overall_compliance` is the WORST sampled target (min, anti-vacuous
+    None when nothing sampled);
+  - metrics are an OBSERVER: output tokens are bit-identical with the
+    registry on vs off (the --no_metrics contract);
+  - a 2-replica fleet's merged snapshot dir equals a single engine's
+    aggregate on the same seeded stream, bucket-for-bucket — the
+    merged-fleet == single-engine acceptance proof;
+  - `kind="slo"`/`kind="metrics"` rows land in the JSONL,
+    `tools/report.py --min_slo_compliance` and
+    `--compare/--max_regression_pct` gate on them with exit 2 (failing
+    on slo-less / compare-less logs — anti-vacuous), and report/top
+    render the slo + metrics panels;
+  - `tpukit/obs/metrics.py` stays stdlib-only (no jax/numpy/tpukit
+    import) — top.py and report.py load it by file path on machines
+    without jax (lint_invariants' stdlib-only rule is the other owner).
+"""
+
+import importlib
+import json
+import math
+import random
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpukit.data import WordTokenizer, synthetic_stories
+from tpukit.model import GPTConfig, init_params
+from tpukit.obs import StepLogger, TraceRecorder
+from tpukit.obs import metrics as metrics_lib
+from tpukit.obs.metrics import (
+    EDGES,
+    HI,
+    LO,
+    OVERFLOW,
+    QUANTILE_REL_ERROR,
+    UNDERFLOW,
+    Histogram,
+    MetricRegistry,
+    SloAccountant,
+    SloSpecError,
+    bucket_index,
+    parse_slo,
+)
+from tpukit.serve import (
+    FleetConfig,
+    FleetRouter,
+    ServeConfig,
+    ServeEngine,
+    synthetic_request_stream,
+)
+
+MAX_NEW = 10
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return WordTokenizer(synthetic_stories(64))
+
+
+@pytest.fixture(scope="module")
+def cfg(tok):
+    return GPTConfig(
+        dim=32, head_dim=8, heads=4, num_layers=2, vocab_size=tok.vocab_size,
+        max_position_embeddings=64, compute_dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(jax.random.PRNGKey(1), cfg)
+
+
+@pytest.fixture(scope="module")
+def host_params(params):
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
+
+
+# ---------------------------------------------------------------------------
+# Bucket placement + histogram edge cases.
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_index_respects_edges():
+    assert bucket_index(LO / 2) == UNDERFLOW
+    assert bucket_index(0.0) == UNDERFLOW
+    assert bucket_index(HI) == OVERFLOW
+    assert bucket_index(HI * 10) == OVERFLOW
+    # every finite bucket i holds exactly [EDGES[i-1], EDGES[i])
+    for k in range(0, metrics_lib.N_BUCKETS, 17):
+        i = bucket_index(EDGES[k])
+        assert i == k + 1, f"edge {k}: landed in bucket {i}"
+        assert EDGES[i - 1] <= EDGES[k] < EDGES[i]
+    # values strictly inside a bucket stay there
+    rng = random.Random(11)
+    for _ in range(500):
+        v = math.exp(rng.uniform(math.log(LO), math.log(HI * 0.999)))
+        i = bucket_index(v)
+        assert 1 <= i <= metrics_lib.N_BUCKETS
+        assert EDGES[i - 1] <= v < EDGES[i]
+
+
+def test_histogram_empty_and_one_sample():
+    h = Histogram()
+    assert h.quantile(0.5) is None and h.fraction_le(1.0) is None
+    s = h.summary()
+    assert s["count"] == 0 and s["min"] is None and s["p99"] is None
+    h.observe(0.005)
+    # one sample: every quantile clamps to the exact value (min == max)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert h.quantile(q) == 0.005
+    assert h.summary()["p50"] == 0.005
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    # n<=0 observes are dropped, not negative
+    h.observe(1.0, n=0)
+    assert h.count == 1
+
+
+def test_underflow_overflow_clamp_to_exact_min_max():
+    h = Histogram()
+    h.observe(LO / 10)     # underflow
+    h.observe(HI * 3)      # overflow
+    h.observe(0.01)
+    assert UNDERFLOW in h.buckets and OVERFLOW in h.buckets
+    assert h.quantile(0.0) == LO / 10    # underflow rank -> exact min
+    assert h.quantile(1.0) == HI * 3     # overflow rank -> exact max
+    for q in (0.0, 0.25, 0.5, 0.99, 1.0):
+        assert h.min <= h.quantile(q) <= h.max
+
+
+def _exact_nearest_rank(vals, q):
+    s = sorted(vals)
+    return s[max(0, math.ceil(q * len(s)) - 1)]
+
+
+@pytest.mark.parametrize("name,make", [
+    # bimodal: two spikes five orders of magnitude apart
+    ("bimodal", lambda rng: [1e-4 * rng.uniform(0.95, 1.05) for _ in range(400)]
+                          + [1.0 * rng.uniform(0.95, 1.05) for _ in range(600)]),
+    # heavy tail: pareto-ish, the p99 lives far from the median
+    ("heavy_tail", lambda rng: [1e-3 * rng.paretovariate(1.2) for _ in range(1000)]),
+    # single bucket: everything within one bucket's span
+    ("single_bucket", lambda rng: [0.005 * rng.uniform(1.0, 1.04) for _ in range(200)]),
+    # log-uniform across 6 octave-decades
+    ("log_uniform", lambda rng: [math.exp(rng.uniform(math.log(1e-5), math.log(10.0)))
+                                 for _ in range(1000)]),
+])
+def test_quantile_relative_error_bound(name, make):
+    rng = random.Random(29)
+    vals = make(rng)
+    h = Histogram()
+    for v in vals:
+        h.observe(v)
+    for q in (0.25, 0.5, 0.9, 0.99):
+        exact = _exact_nearest_rank(vals, q)
+        est = h.quantile(q)
+        rel = abs(est - exact) / exact
+        assert rel <= QUANTILE_REL_ERROR + 1e-9, (
+            f"{name} p{100 * q:g}: est {est:.6g} vs exact {exact:.6g} "
+            f"-> rel error {rel:.4f} > bound {QUANTILE_REL_ERROR:.4f}"
+        )
+
+
+def test_fraction_le_exact_on_edges():
+    h = Histogram()
+    for v in (0.001, 0.002, 0.004, 0.008):
+        h.observe(v)
+    # a bound above everything / below everything is exact
+    assert h.fraction_le(1.0) == 1.0
+    assert h.fraction_le(LO / 2) == 0.0
+    # a bound on a bucket edge counts whole buckets exactly
+    i = bucket_index(0.002)
+    assert h.fraction_le(EDGES[i]) == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# Merge: exact, associative, commutative — shuffled shard orders.
+# ---------------------------------------------------------------------------
+
+
+def test_merge_exact_associative_commutative():
+    rng = random.Random(5)
+    vals = [math.exp(rng.uniform(math.log(1e-5), math.log(100.0)))
+            for _ in range(600)]
+    whole = Histogram()
+    for v in vals:
+        whole.observe(v)
+    shards = [Histogram() for _ in range(6)]
+    for i, v in enumerate(vals):
+        shards[i % 6].observe(v)
+
+    def merged_in(order):
+        out = Histogram()
+        for j in order:
+            out.merge(shards[j])
+        return out
+
+    for seed in range(4):  # commutativity: any shard order, same buckets
+        order = list(range(6))
+        random.Random(seed).shuffle(order)
+        m = merged_in(order)
+        assert m.buckets == whole.buckets
+        assert m.count == whole.count
+        assert m.min == whole.min and m.max == whole.max
+        assert m.sum == pytest.approx(whole.sum)
+        for q in (0.5, 0.99):  # identical buckets -> identical quantiles
+            assert m.quantile(q) == whole.quantile(q)
+
+    # associativity: (a+b)+c == a+(b+c), bucket-for-bucket
+    left = Histogram()
+    left.merge(shards[0]); left.merge(shards[1]); left.merge(shards[2])
+    bc = Histogram()
+    bc.merge(shards[1]); bc.merge(shards[2])
+    right = Histogram()
+    right.merge(shards[0]); right.merge(bc)
+    assert left.buckets == right.buckets
+    assert left.count == right.count
+
+
+# ---------------------------------------------------------------------------
+# Registry: labels, snapshot round-trip, merge semantics.
+# ---------------------------------------------------------------------------
+
+
+def _demo_registry():
+    reg = MetricRegistry()
+    reg.inc("reqs", 3, replica=0, reason="eos")
+    reg.inc("reqs", 1, replica=1, reason="length")
+    reg.gauge("occ", 0.5, replica=0)
+    reg.gauge("occ", 0.75, replica=1)
+    for v in (0.001, 0.01, 0.1):
+        reg.observe("lat_s", v, replica=0)
+    reg.observe("lat_s", 0.2, replica=1)
+    return reg
+
+
+def test_registry_snapshot_roundtrip_lossless():
+    reg = _demo_registry()
+    snap = reg.snapshot()
+    back = MetricRegistry.from_snapshot(snap)
+    assert back.snapshot() == snap
+    assert back.counter_value("reqs", replica=0, reason="eos") == 3
+    assert back.sum_counter("reqs") == 4
+    assert back.hist("lat_s", replica=0).count == 3
+    agg = back.aggregate_hist("lat_s")
+    assert agg.count == 4 and agg.max == 0.2
+    assert back.hist_names() == ["lat_s"]
+
+
+def test_registry_merge_semantics():
+    reg = _demo_registry()
+    snap = _demo_registry().snapshot()
+    reg.merge_snapshot(snap)
+    # counters sum, histograms bucket-sum, gauges last-writer-wins
+    assert reg.sum_counter("reqs") == 8
+    assert reg.aggregate_hist("lat_s").count == 8
+    assert reg.counter_value("reqs", replica=0, reason="eos") == 6
+    g = {(r["name"], tuple(sorted(r["labels"].items()))): r["value"]
+         for r in reg.snapshot()["gauges"]}
+    assert g[("occ", (("replica", "0"),))] == 0.5
+
+
+def test_registry_filter_splits_by_label():
+    reg = _demo_registry()
+    r0 = reg.filter(replica=0)
+    assert r0.sum_counter("reqs") == 3
+    assert r0.aggregate_hist("lat_s").count == 3
+    assert r0.hist("lat_s", replica=1) is None
+    # the filtered copy is independent of the parent
+    r0.observe("lat_s", 9.0, replica=0)
+    assert reg.aggregate_hist("lat_s").count == 4
+
+
+# ---------------------------------------------------------------------------
+# Snapshot files: atomic publish, torn-file skip, stale exclusion, merge.
+# ---------------------------------------------------------------------------
+
+
+def test_publish_read_merge_snapshot_dir(tmp_path):
+    d = tmp_path / "metrics"
+    for rep in (0, 1):
+        metrics_lib.publish_snapshot(d, rep, _demo_registry().filter(replica=rep),
+                                     process_count=2, time_s=float(rep))
+    # a torn file: skipped and counted, never raised
+    (d / "metrics-p00042.json").write_text('{"process": 42, "metr')
+    # a stale incarnation from a larger world: excluded under
+    # process_count=2, like heartbeat's straggler check
+    metrics_lib.publish_snapshot(d, 7, _demo_registry(), process_count=8)
+
+    merged, meta = metrics_lib.merge_snapshot_dir(d, process_count=2)
+    assert meta == {"files": 4, "skipped": 1, "stale": 1, "merged": 2}
+    assert merged.sum_counter("reqs") == 4
+    assert merged.aggregate_hist("lat_s").count == 4
+    # without a process_count the stale payload folds in too
+    all_in, meta_all = metrics_lib.merge_snapshot_dir(d)
+    assert meta_all["merged"] == 3 and all_in.sum_counter("reqs") == 8
+
+    metrics_lib.write_merged(d, merged, meta=meta)
+    assert (d / metrics_lib.MERGED_NAME).is_file()
+    prom = (d / metrics_lib.OPENMETRICS_NAME).read_text()
+    assert prom.rstrip().endswith("# EOF")
+    assert "reqs_total" in prom and "lat_s_bucket" in prom
+    # cumulative le series top out at the series count
+    assert 'lat_s_count{replica="0"} 3' in prom
+
+
+def test_read_snapshots_empty_dir(tmp_path):
+    payloads, meta = metrics_lib.read_snapshots(tmp_path / "nope")
+    assert payloads == [] and meta["files"] == 0
+
+
+def test_openmetrics_cumulative_buckets():
+    h = Histogram()
+    for v in (0.001, 0.002, 0.004):
+        h.observe(v)
+    reg = MetricRegistry()
+    for v in (0.001, 0.002, 0.004):
+        reg.observe("w_s", v)
+    text = metrics_lib.to_openmetrics(reg)
+    counts = [int(l.rsplit(" ", 1)[1]) for l in text.splitlines()
+              if l.startswith("w_s_bucket")]
+    assert counts == sorted(counts) and counts[-1] == 3  # cumulative
+    assert "# TYPE w_s histogram" in text
+
+
+# ---------------------------------------------------------------------------
+# SLO grammar + accounting.
+# ---------------------------------------------------------------------------
+
+
+def test_parse_slo_good_spec():
+    targets = parse_slo("ttft<=250ms@p99; tpot<=40ms@p95;e2e<=2s@p99.9")
+    assert [t.metric for t in targets] == ["ttft", "tpot", "e2e"]
+    assert targets[0].bound_s == pytest.approx(0.250)
+    assert targets[1].bound_s == pytest.approx(0.040)
+    assert targets[2].q == pytest.approx(0.999)
+    assert targets[1].budget == pytest.approx(0.05)
+    assert "ttft" in repr(targets[0])
+
+
+@pytest.mark.parametrize("bad", [
+    "ttft<250ms@p99",            # wrong operator
+    "ttft<=250@p99",             # missing unit
+    "latency<=250ms@p99",        # unknown metric
+    "ttft<=250ms@p0",            # quantile at the open boundary
+    "ttft<=250ms@p100",          # quantile at the open boundary
+    "ttft<=0ms@p99",             # zero bound
+    "ttft<=1ms@p99;ttft<=2ms@p95",  # duplicate metric
+    "",                          # empty spec
+    ";;",                        # empty after splitting
+])
+def test_parse_slo_fails_fast(bad):
+    with pytest.raises(SloSpecError):
+        parse_slo(bad)
+
+
+def test_slo_accounting_compliance_and_burn():
+    acc = SloAccountant(parse_slo("ttft<=100ms@p90;e2e<=1s@p99"))
+    # window 1: 10 ttft samples, 2 violations -> burning 2x budget
+    rec = acc.evaluate({"ttft": [0.05] * 8 + [0.2, 0.3], "e2e": []})
+    ttft, e2e = rec["targets"]
+    assert ttft["n"] == 10 and ttft["violations"] == 2
+    assert ttft["compliance"] == pytest.approx(0.8)
+    assert ttft["met"] is False
+    assert ttft["burn"] == pytest.approx(2.0)  # 20% violations / 10% budget
+    assert e2e["n"] == 0 and e2e["compliance"] is None and e2e["burn"] is None
+    # overall = worst SAMPLED target, e2e's emptiness doesn't vacuously pass
+    assert rec["overall_compliance"] == pytest.approx(0.8)
+    # window 2: clean -> cumulative recovers to 0.9, burn to exactly 1.0
+    rec = acc.evaluate({"ttft": [0.05] * 10, "e2e": [0.5]})
+    ttft, e2e = rec["targets"]
+    assert ttft["cum_n"] == 20
+    assert ttft["cum_compliance"] == pytest.approx(0.9)
+    assert ttft["cum_burn"] == pytest.approx(1.0)
+    assert ttft["met"] is True and ttft["burn"] == 0.0
+    assert e2e["cum_compliance"] == 1.0
+    assert rec["overall_compliance"] == pytest.approx(0.9)  # min across targets
+    assert acc.windows == 2
+
+
+def test_slo_overall_none_until_sampled():
+    acc = SloAccountant(parse_slo("ttft<=100ms@p99"))
+    assert acc.overall_compliance() is None
+    acc.evaluate({"ttft": []})
+    assert acc.overall_compliance() is None  # still no samples
+
+
+# ---------------------------------------------------------------------------
+# stdlib-only: metrics.py must stay loadable with no jax installed.
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_module_is_stdlib_only():
+    import ast
+
+    tree = ast.parse(Path(metrics_lib.__file__).read_text())
+    imported = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            imported |= {a.name.split(".")[0] for a in node.names}
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            imported.add(node.module.split(".")[0])
+    assert not imported & {"jax", "numpy", "tpukit"}, (
+        f"metrics.py must stay stdlib-only (top.py/report.py load it by "
+        f"path with no jax installed); imports {sorted(imported)}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: observer discipline + the derived series.
+# ---------------------------------------------------------------------------
+
+
+def test_tokens_bit_identical_metrics_on_off(tok, cfg, params):
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        window_steps=4, temperature=0.9, top_k=5)
+    reqs = list(synthetic_request_stream(tok, 6, seed=5,
+                                         max_new_tokens=MAX_NEW,
+                                         buckets=(8, 16)))
+
+    def run(metrics):
+        eng = ServeEngine(params, cfg, serve, eos_id=int(tok.eos_token_id),
+                          metrics=metrics)
+        return {c.rid: list(map(int, c.ids))
+                for c in eng.run(list(reqs), max_wall_s=300)}
+
+    assert run(None) == run(MetricRegistry())
+
+
+@pytest.fixture(scope="module")
+def metered_run(tok, cfg, params, tmp_path_factory):
+    """One metered+traced serve run shared by the integration tests:
+    generous SLOs (they pass), a metrics_dir, a JSONL log."""
+    tmp = tmp_path_factory.mktemp("metered")
+    log = tmp / "run.jsonl"
+    logger = StepLogger(str(log))
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        window_steps=4)
+    reqs = synthetic_request_stream(tok, 8, seed=3, max_new_tokens=MAX_NEW,
+                                    buckets=(8, 16))
+    metrics = MetricRegistry()
+    eng = ServeEngine(params, cfg, serve, eos_id=int(tok.eos_token_id),
+                      tracer=TraceRecorder(), logger=logger, metrics=metrics,
+                      slo=parse_slo("ttft<=60s@p99;e2e<=120s@p99"),
+                      metrics_dir=str(tmp / "snaps"))
+    comps = eng.run(list(reqs), max_wall_s=300)
+    logger.close()
+    records = [json.loads(l) for l in log.read_text().splitlines()]
+    return dict(eng=eng, metrics=metrics, comps=comps, log=log,
+                records=records, snaps=tmp / "snaps")
+
+
+def test_engine_derives_series_from_completions(metered_run):
+    m, comps = metered_run["metrics"], metered_run["comps"]
+    assert m.sum_counter("serve_requests") == len(comps) == 8
+    assert m.sum_counter("serve_tokens") == sum(c.generated for c in comps)
+    for name in ("serve_e2e_s", "serve_ttft_s", "serve_queue_wait_s",
+                 "serve_tpot_s", "serve_tokens_per_request"):
+        assert m.aggregate_hist(name).count == 8, name
+    # phase walls derived from the span trees, dispatch/sync from quanta
+    assert m.aggregate_hist("serve_phase_s").count > 0
+    assert m.aggregate_hist("serve_dispatch_s").count > 0
+    # e2e dominates ttft per construction
+    assert m.aggregate_hist("serve_e2e_s").max >= m.aggregate_hist("serve_ttft_s").min
+
+
+def test_slo_and_metrics_rows_land_in_jsonl(metered_run):
+    records = metered_run["records"]
+    slo_rows = [r for r in records if r["kind"] == "slo"]
+    assert slo_rows
+    last = slo_rows[-1]
+    assert last["overall_compliance"] == 1.0  # generous bounds
+    assert {t["metric"] for t in last["targets"]} == {"ttft", "e2e"}
+    for t in last["targets"]:
+        assert t["cum_burn"] == 0.0 and t["met"] in (True, None)
+    (mrec,) = [r for r in records if r["kind"] == "metrics"]
+    assert mrec["source"] == "serve" and mrec["hists"]
+    (summ,) = [r for r in records if r["kind"] == "serve_summary"]
+    assert summ["slo_overall_compliance"] == 1.0
+
+
+def test_engine_publishes_and_merges_snapshots(metered_run):
+    snaps = metered_run["snaps"]
+    assert (snaps / metrics_lib.MERGED_NAME).is_file()
+    assert (snaps / metrics_lib.OPENMETRICS_NAME).is_file()
+    merged, meta = metrics_lib.merge_snapshot_dir(snaps)
+    assert meta["merged"] >= 1 and meta["skipped"] == 0
+    m = metered_run["metrics"]
+    assert merged.sum_counter("serve_tokens") == m.sum_counter("serve_tokens")
+    assert (merged.aggregate_hist("serve_e2e_s").buckets
+            == m.aggregate_hist("serve_e2e_s").buckets)
+
+
+# ---------------------------------------------------------------------------
+# Fleet: merged snapshot dir == single engine aggregate, bucket-exact.
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_merged_equals_single_engine(tok, cfg, params, host_params,
+                                           tmp_path):
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        window_steps=8)
+    reqs = list(synthetic_request_stream(tok, 8, seed=3,
+                                         max_new_tokens=MAX_NEW,
+                                         buckets=(8, 16)))
+    m_single = MetricRegistry()
+    eng = ServeEngine(params, cfg, serve, eos_id=int(tok.eos_token_id),
+                      metrics=m_single)
+    comps1 = eng.run(list(reqs), max_wall_s=300)
+
+    snaps = tmp_path / "snaps"
+    m_fleet = MetricRegistry()
+    router = FleetRouter(host_params, cfg, serve,
+                         FleetConfig(replicas=2, window_steps=4),
+                         eos_id=int(tok.eos_token_id), metrics=m_fleet,
+                         metrics_dir=str(snaps))
+    comps2 = router.run(list(reqs), max_wall_s=300)
+
+    # the premise: greedy decode makes per-request token counts a
+    # deterministic function of the request, replica placement aside
+    assert ({c.rid: c.generated for c in comps1}
+            == {c.rid: c.generated for c in comps2})
+
+    merged, meta = metrics_lib.merge_snapshot_dir(snaps)
+    assert meta["merged"] == 2 and meta["skipped"] == 0  # one per replica
+    # deterministic series merge bucket-exact equal to the single engine
+    h1 = m_single.aggregate_hist("serve_tokens_per_request")
+    h2 = merged.aggregate_hist("serve_tokens_per_request")
+    assert h2.buckets == h1.buckets
+    assert (h2.count, h2.min, h2.max) == (h1.count, h1.min, h1.max)
+    assert h2.quantile(0.5) == h1.quantile(0.5)
+    assert merged.sum_counter("serve_requests") == 8
+    assert (merged.sum_counter("serve_tokens")
+            == m_single.sum_counter("serve_tokens"))
+    # ... and the shared in-memory fleet registry agrees with its own
+    # published-files merge (publish -> read -> merge loses nothing)
+    assert (m_fleet.aggregate_hist("serve_tokens_per_request").buckets
+            == h2.buckets)
+
+
+# ---------------------------------------------------------------------------
+# Tools: the report gates, the slo/metrics panels, top.py.
+# ---------------------------------------------------------------------------
+
+
+def test_report_renders_slo_and_metrics_sections(metered_run):
+    report = importlib.import_module("tools.report")
+    text = report.summarize(metered_run["records"])
+    assert "== slo ==" in text
+    assert "== metrics (serve) ==" in text
+    assert "slo: overall compliance 100.00%" in text
+
+
+def test_report_surfaces_trace_ring_evictions(metered_run):
+    report = importlib.import_module("tools.report")
+    records = [dict(r) for r in metered_run["records"]]
+    for r in records:
+        if r["kind"] == "serve_summary":
+            r["trace_dropped"] = 5
+            r["trace_dropped_by_replica"] = {"0": 3, "1": 2}
+    text = report.summarize(records)
+    assert "DROPPED EVENTS" in text and "r0: 3, r1: 2" in text
+    # the healthy log carries no eviction warning
+    assert "DROPPED EVENTS" not in report.summarize(metered_run["records"])
+
+
+def test_report_min_slo_compliance_gate(metered_run, tmp_path):
+    report = importlib.import_module("tools.report")
+    records, log = metered_run["records"], metered_run["log"]
+    ok, msg = report.check_min_slo_compliance(records, 0.99)
+    assert ok and "OK" in msg
+    ok, msg = report.check_min_slo_compliance(records, 1.01)
+    assert not ok
+    # anti-vacuous: a log with no slo rows FAILS the gate
+    ok, msg = report.check_min_slo_compliance(
+        [r for r in records if r["kind"] != "slo"], 0.5)
+    assert not ok and "--slo" in msg
+    # exit-2 wiring
+    assert report.main([str(log), "--min_slo_compliance", "0.99"]) == 0
+    assert report.main([str(log), "--min_slo_compliance", "1.01"]) == 2
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text(json.dumps({"kind": "compile_cache", "hits": 0}) + "\n")
+    assert report.main([str(bare), "--min_slo_compliance", "0.5"]) == 2
+
+
+def test_report_compare_and_regression_gate(metered_run, tmp_path):
+    report = importlib.import_module("tools.report")
+    log = metered_run["log"]
+    # self-compare: ~0% regression, the gate passes
+    assert report.main([str(log), "--compare", str(log),
+                        "--max_regression_pct", "5"]) == 0
+    # a baseline whose latencies were 10x lower -> current is a huge
+    # regression -> exit 2
+    doctored = []
+    for r in metered_run["records"]:
+        r = dict(r)
+        if r["kind"] == "metrics":
+            r["hists"] = [
+                {**h, "p50": (h["p50"] or 0) / 10, "p99": (h["p99"] or 0) / 10}
+                for h in r["hists"]
+            ]
+        doctored.append(r)
+    base = tmp_path / "baseline.jsonl"
+    base.write_text("\n".join(json.dumps(r) for r in doctored) + "\n")
+    assert report.main([str(log), "--compare", str(base),
+                        "--max_regression_pct", "50"]) == 2
+    # anti-vacuous: gating on regression without a baseline fails
+    assert report.main([str(log), "--max_regression_pct", "50"]) == 2
+    ok, msg = report.check_max_regression_pct(metered_run["records"], 50.0)
+    assert not ok and "--compare" in msg
+
+
+def test_top_renders_one_frame(metered_run, capsys):
+    top = importlib.import_module("tools.top")
+    rc = top.main([str(metered_run["snaps"]), "--once",
+                   "--log", str(metered_run["log"])])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "tpukit top" in out
+    assert "serve_e2e_s" in out
+    assert "slo (" in out  # the SLO panel from --log
+
+
+def test_top_exits_nonzero_without_snapshots(tmp_path, capsys):
+    top = importlib.import_module("tools.top")
+    assert top.main([str(tmp_path / "empty"), "--once"]) == 1

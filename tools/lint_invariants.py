@@ -62,6 +62,17 @@ re-break in review because the broken form LOOKS idiomatic:
                      a reasoned waiver (it streams lse + argmax
                      tie-break state, a different contract than the
                      owner's `(m, l, correction, p)`).
+  stdlib-only        `tpukit/obs/trace.py` and `tpukit/obs/metrics.py`
+                     import NOTHING heavier than the stdlib — no jax,
+                     no numpy, no tpukit (round 22; trace.py pioneered
+                     the discipline, metrics.py is the second owner).
+                     The post-mortem tools (traceview.py, top.py,
+                     report.py) load them by file path on machines the
+                     logs were merely copied to, and `import tpukit`
+                     transitively pulls jax; one convenience import
+                     silently breaks every offline consumer. Flags any
+                     `import`/`from ... import` of jax/numpy/tpukit (or
+                     a submodule) in those two files.
 
 Waivers: a site that is legitimately outside a rule carries an inline
 comment on the flagged line —
@@ -98,7 +109,13 @@ SCAN_GLOBS = (
 )
 
 RULES = ("atomic-publish", "retry-io", "sampling-spelling",
-         "collective-spelling", "online-softmax-spelling")
+         "collective-spelling", "online-softmax-spelling", "stdlib-only")
+
+# Module roots banned in the stdlib-only files: anything that would make
+# a by-file-path load pull an accelerator stack (tpukit/__init__ imports
+# jax via tpukit.model).
+_HEAVY_ROOTS = frozenset({"jax", "jaxlib", "numpy", "np", "tpukit",
+                          "flax", "optax"})
 
 # The raw checkpoint I/O helpers that must ride retry_io.
 _RAW_IO_HELPERS = frozenset({
@@ -148,7 +165,8 @@ class _Visitor(ast.NodeVisitor):
     def __init__(self, path: Path, rel: str, lines: list[str],
                  owner_funcs: frozenset[str],
                  wire_collective_owner: bool = False,
-                 ops_kernel_file: bool = False):
+                 ops_kernel_file: bool = False,
+                 stdlib_only_file: bool = False):
         self.path = path
         self.rel = rel
         self.lines = lines
@@ -162,6 +180,9 @@ class _Visitor(ast.NodeVisitor):
         # True for files under tpukit/ops/: the only tree where the
         # online-softmax-spelling rule applies (kernel code)
         self.ops_kernel_file = ops_kernel_file
+        # True for tpukit/obs/{trace,metrics}.py: the by-file-path
+        # loadable modules that must stay jax/numpy/tpukit-free
+        self.stdlib_only_file = stdlib_only_file
         self.out: list[Violation] = []
         self.func_stack: list[str] = []
         # names bound by `from os import replace/rename` in this file
@@ -209,11 +230,31 @@ class _Visitor(ast.NodeVisitor):
                     self._max_names[-1].add(t.id)
         self.generic_visit(node)
 
+    def _check_stdlib_only(self, node: ast.AST, module: str) -> None:
+        if not self.stdlib_only_file:
+            return
+        root = module.split(".")[0]
+        if root in _HEAVY_ROOTS:
+            self._flag(
+                "stdlib-only", node,
+                f"import of {module} in a stdlib-only module — "
+                f"traceview.py/top.py/report.py load this file by path on "
+                f"machines without jax; keep it importable bare (round-22 "
+                f"discipline, tests assert it too)",
+            )
+
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            self._check_stdlib_only(node, a.name)
+        self.generic_visit(node)
+
     def visit_ImportFrom(self, node: ast.ImportFrom):
         if node.module == "os":
             for a in node.names:
                 if a.name in ("replace", "rename"):
                     self.os_fn_aliases.add(a.asname or a.name)
+        if node.module and node.level == 0:
+            self._check_stdlib_only(node, node.module)
         self.generic_visit(node)
 
     def _is_rename_call(self, node: ast.Call) -> str | None:
@@ -344,6 +385,8 @@ def lint_file(path: Path, rel: str | None = None) -> list[Violation]:
         path, rel, source.splitlines(), frozenset(owners),
         wire_collective_owner=norm.endswith("tpukit/ops/quant_comm.py"),
         ops_kernel_file="tpukit/ops/" in norm,
+        stdlib_only_file=(norm.endswith("tpukit/obs/trace.py")
+                          or norm.endswith("tpukit/obs/metrics.py")),
     )
     v.visit(tree)
     return v.out

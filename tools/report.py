@@ -46,7 +46,17 @@ Round-21 fused decode adds bench.py's `decode_fused` record (the kernel
 win and the dispatch-amortization win rendered separately) and the
 `--min_decode_speedup` gate on the amortization ratio — the number that
 transfers from CPU loopback, because the kernel cost cancels out of it.
-This tool needs NOTHING but
+Round-22 metrics plane adds "slo" rows (per-window compliance +
+error-budget burn per `--slo` target) and "metrics" epilogues (compact
+per-series summaries from tpukit/obs/metrics.py), rendered as
+"== slo ==" / "== metrics ==" sections; `--compare baseline.jsonl`
+diffs two runs' metric summaries (per-histogram p50/p99 deltas plus the
+tokens/s headline); the `--min_slo_compliance` and
+`--max_regression_pct` gates CI them; bench.py's `metrics_overhead`
+record (pure-observer proof: token parity + <1% throughput) renders
+too. The accreted per-gate argparse/dispatch boilerplate is
+consolidated into the declarative GATES table below — one row per gate,
+checker functions unchanged. This tool needs NOTHING but
 the file — no jax import, so it runs anywhere the log was copied to.
 
 Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
@@ -54,6 +64,9 @@ Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
                                         [--min_accept_rate 0.3]
                                         [--min_trace_complete 1.0]
                                         [--min_decode_speedup 1.0]
+                                        [--min_slo_compliance 0.99]
+                                        [--compare baseline.jsonl]
+                                        [--max_regression_pct 10]
 """
 
 from __future__ import annotations
@@ -110,6 +123,21 @@ def _fmt_fractions(frac: dict) -> str:
     )
 
 
+def _fmt_labels(labels) -> str:
+    """Compact `{k=v,...}` suffix for a metric series; empty labels
+    render as nothing."""
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+
+
+def _fmt_seconds(v) -> str:
+    """Latency cell: ms below 1s, seconds above, '-' for empty series."""
+    if v is None:
+        return "-"
+    return f"{v * 1e3:.2f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
 def _phase_lines(r: dict) -> list[str]:
     """Round-20 request-trace rows on a serve_summary / fleet_summary:
     per-phase p50/p99 walls and the span-tree completeness fraction."""
@@ -128,6 +156,21 @@ def _phase_lines(r: dict) -> list[str]:
     if comp is not None:
         out.append(f"  traces: {100 * comp:.0f}% complete span trees"
                    + ("" if comp >= 1.0 else "  <- INCOMPLETE TREES"))
+    # round-22: the recorder's ring evictions, surfaced per summary — a
+    # saturated ring silently truncates span trees, so a nonzero count
+    # gets a visible warning instead of hiding in the raw record
+    dropped = r.get("trace_dropped")
+    if dropped:
+        by_rep = r.get("trace_dropped_by_replica")
+        out.append(
+            f"  trace ring evicted {dropped} span event(s)"
+            + (f" ({', '.join(f'r{k}: {v}' for k, v in sorted(by_rep.items()))})"
+               if by_rep else "")
+            + "  <- DROPPED EVENTS (grow --trace_capacity)")
+    slo_c = r.get("slo_overall_compliance")
+    if slo_c is not None:
+        out.append(f"  slo: overall compliance {100 * slo_c:.2f}%"
+                   + ("" if slo_c >= 1.0 else "  (see == slo ==)"))
     return out
 
 
@@ -581,6 +624,53 @@ def summarize(records: list[dict]) -> str:
             + (f"(r{r['replica']})" if r.get("replica") is not None else "")
             for r in fleet_events))
 
+    # round-22 SLO accounting (tpukit/obs/metrics.py): declared targets,
+    # cumulative compliance, and error-budget burn. The LAST record
+    # carries the run-level cumulative rows (sample-weighted), earlier
+    # ones are per-window snapshots; burn > 1 means the run is consuming
+    # error budget faster than the objective allows.
+    slo_rows = _rows(records, "slo")
+    if slo_rows:
+        last = slo_rows[-1]
+        w("== slo ==")
+        oc = last.get("overall_compliance")
+        w(f"  {len(slo_rows)} slo window(s); overall compliance: "
+          + (f"{100 * oc:.2f}%" if oc is not None else "no samples"))
+        for t in last.get("targets") or []:
+            cc, cb = t.get("cum_compliance"), t.get("cum_burn")
+            if cc is None:
+                w(f"  {t.get('slo', '?'):<20} no samples")
+                continue
+            met = cc >= (t.get("q") or 0)
+            w(f"  {t.get('slo', '?'):<20} compliance {100 * cc:.2f}% "
+              f"over {t.get('cum_n', '?')} samples   burn {cb:.2f}x budget"
+              + ("" if met else "  <- VIOLATED"))
+    # round-22 metrics epilogues: the registry's compact per-series
+    # summaries (full bucket tables live in --metrics_dir snapshots).
+    # Counters one line, histograms a small table — enough to eyeball a
+    # run without the live dashboard (tools/top.py renders the same
+    # registry continuously).
+    for r in _rows(records, "metrics"):
+        w(f"== metrics ({r.get('source', '?')}) ==")
+        counters = r.get("counters") or []
+        if counters:
+            w("  counters: " + "  ".join(
+                f"{c['name']}{_fmt_labels(c.get('labels'))}="
+                f"{human_count(c['value'])}"
+                for c in counters))
+        hists = r.get("hists") or []
+        if hists:
+            w(f"  {'histogram':<36} {'count':>8} {'p50':>10} {'p99':>10}")
+            for h in hists:
+                p50, p99 = h.get("p50"), h.get("p99")
+                # the `_s` suffix convention names the time-valued series;
+                # everything else (token counts, ...) renders as a count
+                fmt = (_fmt_seconds if h["name"].endswith("_s")
+                       else lambda v: "-" if v is None else human_count(v))
+                w(f"  {h['name'] + _fmt_labels(h.get('labels')):<36} "
+                  f"{human_count(h.get('count')):>8} "
+                  f"{fmt(p50):>10} {fmt(p99):>10}")
+
     cache_rows = _rows(records, "compile_cache")
     if cache_rows:
         w("== compile cache ==")
@@ -815,6 +905,32 @@ def summarize(records: list[dict]) -> str:
               f"{am:.2f}x  <- the gated, backend-transferable number")
         w("  token parity across all rungs: "
           + ("exact" if df.get("parity_ok") else "<- MISMATCH"))
+    # round-22 metrics-overhead bench: the pure-observer proof. Tokens
+    # must be bit-identical with the metrics plane on vs --no_metrics,
+    # and the throughput cost must stay under the 1% budget; the
+    # snapshot-publish wall is the only new I/O and is timed separately.
+    for r in records:
+        mo = r.get("metrics_overhead")
+        if not isinstance(mo, dict):
+            continue
+        w("== metrics overhead (bench, pure-observer proof) ==")
+        if "error" in mo:
+            w(f"  ERROR {mo['error']}")
+            continue
+        off, on = mo.get("tokens_per_sec_off"), mo.get("tokens_per_sec_on")
+        frac = mo.get("overhead_frac")
+        w(f"  {mo.get('requests', '?')} requests: "
+          f"{human_count(off)} tokens/s metrics-off vs {human_count(on)} on"
+          + (f"   overhead {100 * frac:.2f}%"
+             + ("" if frac <= 0.01 else "  <- ABOVE the 1% budget")
+             if frac is not None else ""))
+        w("  token parity on vs off: "
+          + ("bit-identical" if mo.get("tokens_bit_identical")
+             else "<- MISMATCH")
+          + (f"   snapshot publish {mo['snapshot_publish_s'] * 1e3:.2f} ms"
+             if mo.get("snapshot_publish_s") is not None else "")
+          + (f"   ({mo['series']} series)"
+             if mo.get("series") is not None else ""))
     # round-19 fleet bench (ROADMAP #1): the replica scaling curve at
     # equal total devices + the disaggregated-prefill admit-latency
     # comparison, with the CPU-loopback caveat carried in-record.
@@ -1079,85 +1195,273 @@ def check_min_decode_speedup(records: list[dict],
                    "(did the bench run the fused rungs?)")
 
 
+# ---- round-22 cross-run comparison (--compare baseline.jsonl) ------------
+
+
+def _metric_series(records: list[dict]) -> tuple[dict, dict]:
+    """Index the LAST `kind="metrics"` epilogue per source: histograms
+    keyed by (source, name, labels) and tokens/s-style gauges the same
+    way. Later epilogues supersede earlier ones (a train run followed by
+    a serve run in one log compares source by source)."""
+    hists: dict = {}
+    gauges: dict = {}
+    for r in _rows(records, "metrics"):
+        src = r.get("source", "?")
+        for h in r.get("hists") or []:
+            key = (src, h["name"], tuple(sorted((h.get("labels") or {}).items())))
+            hists[key] = h
+        for g in r.get("gauges") or []:
+            if g["name"].endswith("tokens_per_sec"):
+                key = (src, g["name"],
+                       tuple(sorted((g.get("labels") or {}).items())))
+                gauges[key] = g["value"]
+    return hists, gauges
+
+
+def compare_runs(current: list[dict], baseline: list[dict],
+                 baseline_path: str = "") -> dict:
+    """Diff two runs' metric summaries: per-histogram p50/p99 deltas
+    (positive = current slower — a regression for latency series) and
+    tokens/s deltas (negative = regression). Returns a `kind="compare"`
+    record; worst_regression_pct is the single gated number — the worst
+    drift across every comparable series, sign-normalized so bigger is
+    always worse."""
+    cur_h, cur_g = _metric_series(current)
+    base_h, base_g = _metric_series(baseline)
+    rows, thr_rows = [], []
+    worst: tuple | None = None
+
+    def consider(delta_pct: float, name: str):
+        nonlocal worst
+        if worst is None or delta_pct > worst[0]:
+            worst = (delta_pct, name)
+
+    for key in sorted(set(cur_h) & set(base_h), key=str):
+        src, name, lk = key
+        bh, ch = base_h[key], cur_h[key]
+        row = {"source": src, "name": name, "labels": dict(lk)}
+        have = False
+        for q in ("p50", "p99"):
+            b, c = bh.get(q), ch.get(q)
+            if b is None or c is None or b <= 0:
+                continue
+            d = 100.0 * (c - b) / b
+            row[f"base_{q}"], row[f"cur_{q}"] = b, c
+            row[f"{q}_delta_pct"] = d
+            have = True
+            # only the `_s` (time-valued) series gate as latency
+            # regressions; count-valued histograms are informational
+            if name.endswith("_s"):
+                consider(d, f"{src}/{name}{_fmt_labels(dict(lk))} {q}")
+        if have:
+            rows.append(row)
+    for key in sorted(set(cur_g) & set(base_g), key=str):
+        src, name, lk = key
+        b, c = base_g[key], cur_g[key]
+        if not b:
+            continue
+        d = 100.0 * (c - b) / b
+        thr_rows.append({"source": src, "name": name, "labels": dict(lk),
+                         "base": b, "cur": c, "delta_pct": d})
+        consider(-d, f"{src}/{name} tokens/s")
+    # summary-record throughput rides along even without metrics
+    # epilogues, so --compare works on pre-round-22 baselines too
+    for kind in ("serve_summary", "fleet_summary"):
+        b = [r for r in _rows(baseline, kind) if r.get("tokens_per_sec")]
+        c = [r for r in _rows(current, kind) if r.get("tokens_per_sec")]
+        if b and c:
+            bv, cv = b[-1]["tokens_per_sec"], c[-1]["tokens_per_sec"]
+            d = 100.0 * (cv - bv) / bv
+            thr_rows.append({"source": kind, "name": "tokens_per_sec",
+                             "labels": {}, "base": bv, "cur": cv,
+                             "delta_pct": d})
+            consider(-d, f"{kind} tokens/s")
+    return {
+        "kind": "compare", "baseline": baseline_path,
+        "rows": rows, "throughput": thr_rows,
+        "worst_regression_pct": None if worst is None else worst[0],
+        "worst_name": None if worst is None else worst[1],
+    }
+
+
+def render_compare(cmp: dict) -> str:
+    out: list[str] = []
+    w = out.append
+    w(f"== compare (vs {cmp.get('baseline') or 'baseline'}) ==")
+    rows, thr = cmp.get("rows") or [], cmp.get("throughput") or []
+    if not rows and not thr:
+        w("  no comparable metric series between the runs")
+        return "\n".join(out)
+    for t in thr:
+        w(f"  {t['source'] + '/' + t['name'] + _fmt_labels(t['labels']):<44} "
+          f"{human_count(t['base']):>9} -> {human_count(t['cur']):>9} "
+          f"tokens/s  {t['delta_pct']:+.1f}%"
+          + ("" if t["delta_pct"] >= 0 else "  <- SLOWER"))
+    if rows:
+        w(f"  {'histogram':<40} {'p50 base->cur':>22} {'Δ%':>7} "
+          f"{'p99 base->cur':>22} {'Δ%':>7}")
+    for row in rows:
+        fmt = (_fmt_seconds if row["name"].endswith("_s")
+               else lambda v: "-" if v is None else human_count(v))
+        cells = f"  {row['name'] + _fmt_labels(row['labels']):<40}"
+        for q in ("p50", "p99"):
+            d = row.get(f"{q}_delta_pct")
+            if d is None:
+                cells += f" {'-':>22} {'-':>7}"
+            else:
+                cells += (f" {fmt(row[f'base_{q}']) + ' -> ' + fmt(row[f'cur_{q}']):>22}"
+                          f" {d:+6.1f}%")
+        w(cells)
+    wr = cmp.get("worst_regression_pct")
+    if wr is not None:
+        w(f"  worst regression: {wr:+.1f}% ({cmp.get('worst_name')})")
+    return "\n".join(out)
+
+
+def check_min_slo_compliance(records: list[dict],
+                             threshold: float) -> tuple[bool, str]:
+    """SLO gate (`--min_slo_compliance`, round 22): the LAST
+    `kind="slo"` record's overall_compliance (the worst cumulative
+    per-target compliance, sample-weighted) must reach `threshold`.
+    Returns (ok, message) — a log without slo rows fails, so the gate
+    can't pass vacuously when someone drops `--slo` from the smoke
+    invocation; so does a declared target that never saw a sample."""
+    slo = _rows(records, "slo")
+    if not slo:
+        return False, ("--min_slo_compliance: no slo record in the log "
+                       "(was the run started with --slo?)")
+    last = slo[-1]
+    comp = last.get("overall_compliance")
+    if comp is None:
+        return False, ("--min_slo_compliance FAIL: declared slo targets "
+                       "saw no samples")
+    targets = [t for t in last.get("targets") or []
+               if t.get("cum_compliance") is not None]
+    worst = min(targets, key=lambda t: t["cum_compliance"]) if targets else None
+    ok = comp >= threshold
+    verdict = "OK" if ok else "FAIL"
+    return ok, (
+        f"--min_slo_compliance {verdict}: overall compliance {comp:.4f} "
+        f"over {len(slo)} slo window(s)"
+        + (f", worst target {worst['slo']} at "
+           f"{worst['cum_compliance']:.4f} (burn {worst['cum_burn']:.2f}x)"
+           if worst is not None else "")
+        + f" (threshold {threshold:.4f})"
+    )
+
+
+def check_max_regression_pct(records: list[dict],
+                             threshold: float) -> tuple[bool, str]:
+    """Cross-run regression gate (`--max_regression_pct`, round 22):
+    the `--compare` diff's worst sign-normalized drift (latency p50/p99
+    up, or tokens/s down) must stay <= `threshold` percent. Reads the
+    `kind="compare"` record main() appends after diffing, so it slots
+    into the same declarative gate table as every other checker; without
+    `--compare` there is nothing to gate and the check fails loudly."""
+    cmps = _rows(records, "compare")
+    if not cmps:
+        return False, ("--max_regression_pct: no comparison in the log "
+                       "(pass --compare baseline.jsonl)")
+    cmp = cmps[-1]
+    worst = cmp.get("worst_regression_pct")
+    if worst is None:
+        return False, ("--max_regression_pct FAIL: no comparable metric "
+                       "series between the runs")
+    ok = worst <= threshold
+    verdict = "OK" if ok else "FAIL"
+    return ok, (
+        f"--max_regression_pct {verdict}: worst drift {worst:+.1f}% "
+        f"({cmp.get('worst_name')}) vs baseline "
+        f"(threshold {threshold:.1f}%)"
+    )
+
+
+# ---- the gate table (round 22) -------------------------------------------
+#
+# Every CI gate is one row: (flag dest, metavar, checker, help). main()
+# generates the argparse options AND the check-dispatch loop from this
+# table, so a new gate is a one-row diff instead of the two copy-pasted
+# blocks each of the first five gates accreted. Row order is evaluation
+# order (and --help order) — it preserves the pre-table behavior exactly.
+# Checkers keep the uniform (records, threshold) -> (ok, message)
+# contract; anything extra a checker needs (the --compare diff) is
+# materialized into `records` first.
+
+GATES: tuple = (
+    ("min_goodput", "FRACTION", check_min_goodput,
+     "assert mean train-window goodput >= FRACTION (exit 2 below "
+     "it) — a cheap perf regression gate for CI"),
+    ("min_serve_tps", "TOKENS_PER_SEC", check_min_serve_tps,
+     "assert the serve_summary tokens/s >= this (exit 2 below it) "
+     "— the serving-throughput regression gate for CI"),
+    ("min_accept_rate", "FRACTION", check_min_accept_rate,
+     "assert the serve_summary speculative-decoding acceptance "
+     "rate >= FRACTION (exit 2 below it, or when the log has no spec "
+     "summary) — the draft-health regression gate for CI"),
+    ("min_fleet_tps", "TOKENS_PER_SEC", check_min_fleet_tps,
+     "assert the fleet_summary tokens/s >= this with zero "
+     "duplicate completions (exit 2 below it, or when the log has no "
+     "fleet summary) — the fleet-serving regression gate for CI"),
+    ("min_trace_complete", "FRACTION", check_min_trace_complete,
+     "assert the fraction of complete request span trees "
+     "(kind=\"trace\" rows: closed AND phase walls summing to e2e "
+     "within 1e-3 s) >= FRACTION (exit 2 below it, or when the log "
+     "has no trace rows) — the tracing-integrity gate for CI"),
+    ("min_overlap_frac", "FRACTION", check_min_overlap_frac,
+     "assert every bucketed comm_overlap bench rung's "
+     "overlap_frac (hlolint-measured hidden-wires fraction) >= "
+     "FRACTION (exit 2 below it, or when the log has no overlap "
+     "rung) — the overlap-schedule regression gate for CI"),
+    ("min_decode_speedup", "RATIO", check_min_decode_speedup,
+     "assert the decode_fused bench record's amortization_speedup "
+     "(on-device scheduler loop vs per-step dispatch) >= RATIO with "
+     "token parity intact (exit 2 below it, or when the log has no "
+     "decode_fused record) — the round-21 fused-decode regression gate"),
+    ("min_slo_compliance", "FRACTION", check_min_slo_compliance,
+     "assert the run's cumulative SLO compliance (worst target in the "
+     "last kind=\"slo\" record) >= FRACTION (exit 2 below it, or when "
+     "the log has no slo rows) — the round-22 SLO regression gate for CI"),
+    ("max_regression_pct", "PERCENT", check_max_regression_pct,
+     "assert the --compare diff's worst drift (latency p50/p99 up or "
+     "tokens/s down, sign-normalized) <= PERCENT (exit 2 above it, or "
+     "without --compare) — the round-22 cross-run regression gate"),
+)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("log", help="metrics JSONL written via --metrics_log")
     ap.add_argument(
-        "--min_goodput", type=float, default=None, metavar="FRACTION",
-        help="assert mean train-window goodput >= FRACTION (exit 2 below "
-        "it) — a cheap perf regression gate for CI",
+        "--compare", default=None, metavar="BASELINE_JSONL",
+        help="diff this run's metric summaries (kind=\"metrics\" "
+        "histogram p50/p99, tokens/s headline) against a baseline run's "
+        "JSONL; gate the worst drift with --max_regression_pct",
     )
-    ap.add_argument(
-        "--min_serve_tps", type=float, default=None, metavar="TOKENS_PER_SEC",
-        help="assert the serve_summary tokens/s >= this (exit 2 below it) "
-        "— the serving-throughput regression gate for CI",
-    )
-    ap.add_argument(
-        "--min_accept_rate", type=float, default=None, metavar="FRACTION",
-        help="assert the serve_summary speculative-decoding acceptance "
-        "rate >= FRACTION (exit 2 below it, or when the log has no spec "
-        "summary) — the draft-health regression gate for CI",
-    )
-    ap.add_argument(
-        "--min_fleet_tps", type=float, default=None, metavar="TOKENS_PER_SEC",
-        help="assert the fleet_summary tokens/s >= this with zero "
-        "duplicate completions (exit 2 below it, or when the log has no "
-        "fleet summary) — the fleet-serving regression gate for CI",
-    )
-    ap.add_argument(
-        "--min_trace_complete", type=float, default=None, metavar="FRACTION",
-        help="assert the fraction of complete request span trees "
-        "(kind=\"trace\" rows: closed AND phase walls summing to e2e "
-        "within 1e-3 s) >= FRACTION (exit 2 below it, or when the log "
-        "has no trace rows) — the tracing-integrity gate for CI",
-    )
-    ap.add_argument(
-        "--min_overlap_frac", type=float, default=None, metavar="FRACTION",
-        help="assert every bucketed comm_overlap bench rung's "
-        "overlap_frac (hlolint-measured hidden-wires fraction) >= "
-        "FRACTION (exit 2 below it, or when the log has no overlap "
-        "rung) — the overlap-schedule regression gate for CI",
-    )
-    ap.add_argument(
-        "--min_decode_speedup", type=float, default=None, metavar="RATIO",
-        help="assert the decode_fused bench record's amortization_speedup "
-        "(on-device scheduler loop vs per-step dispatch) >= RATIO with "
-        "token parity intact (exit 2 below it, or when the log has no "
-        "decode_fused record) — the round-21 fused-decode regression gate",
-    )
+    for dest, metavar, _check, help_text in GATES:
+        ap.add_argument(
+            f"--{dest}", type=float, default=None, metavar=metavar,
+            help=help_text,
+        )
     args = ap.parse_args(argv)
     records = load(args.log)
     if not records:
         print(f"{args.log}: no records", file=sys.stderr)
         return 1
     print(summarize(records))
+    if args.compare is not None:
+        baseline = load(args.compare)
+        if not baseline:
+            print(f"{args.compare}: no records", file=sys.stderr)
+            return 1
+        cmp = compare_runs(records, baseline, baseline_path=args.compare)
+        print(render_compare(cmp))
+        records.append(cmp)  # --max_regression_pct reads it like any row
     rc = 0
-    if args.min_goodput is not None:
-        ok, msg = check_min_goodput(records, args.min_goodput)
-        print(msg, file=sys.stdout if ok else sys.stderr)
-        rc = rc if ok else 2
-    if args.min_serve_tps is not None:
-        ok, msg = check_min_serve_tps(records, args.min_serve_tps)
-        print(msg, file=sys.stdout if ok else sys.stderr)
-        rc = rc if ok else 2
-    if args.min_accept_rate is not None:
-        ok, msg = check_min_accept_rate(records, args.min_accept_rate)
-        print(msg, file=sys.stdout if ok else sys.stderr)
-        rc = rc if ok else 2
-    if args.min_fleet_tps is not None:
-        ok, msg = check_min_fleet_tps(records, args.min_fleet_tps)
-        print(msg, file=sys.stdout if ok else sys.stderr)
-        rc = rc if ok else 2
-    if args.min_trace_complete is not None:
-        ok, msg = check_min_trace_complete(records, args.min_trace_complete)
-        print(msg, file=sys.stdout if ok else sys.stderr)
-        rc = rc if ok else 2
-    if args.min_overlap_frac is not None:
-        ok, msg = check_min_overlap_frac(records, args.min_overlap_frac)
-        print(msg, file=sys.stdout if ok else sys.stderr)
-        rc = rc if ok else 2
-    if args.min_decode_speedup is not None:
-        ok, msg = check_min_decode_speedup(records, args.min_decode_speedup)
+    for dest, _metavar, check, _help in GATES:
+        threshold = getattr(args, dest)
+        if threshold is None:
+            continue
+        ok, msg = check(records, threshold)
         print(msg, file=sys.stdout if ok else sys.stderr)
         rc = rc if ok else 2
     return rc

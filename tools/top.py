@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Live terminal dashboard over a run's `--metrics_dir` (round 22).
+
+Tails the atomic per-process snapshot files the metrics plane publishes
+every window (tpukit/obs/metrics.py), merges them locally by bucket-wise
+sum — the same merge process 0 performs, so what this tool shows IS the
+fleet view — and redraws a compact panel: tokens/s, occupancy, queue
+depth, page-pool pressure, per-series p50/p99 latencies with a bucket
+sparkline of each distribution's shape, recovery counters, and (with
+`--log run.jsonl`) the declared SLO targets' cumulative compliance and
+burn plus a tokens/s-over-windows sparkline.
+
+Like report.py and traceview.py this tool imports NO jax (or numpy):
+`tpukit/obs/metrics.py` is deliberately stdlib-only and is loaded by
+file path below, bypassing `tpukit/__init__` (which imports jax). It
+therefore runs on a machine the snapshot dir was merely rsync'd to.
+
+Usage:
+    python tools/top.py /path/to/metrics_dir            # live, 2s redraw
+    python tools/top.py metrics_dir --log run.jsonl     # + SLO panel
+    python tools/top.py metrics_dir --once              # one frame (CI)
+Exit codes: 0 rendered, 1 no snapshots in the directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _load_metrics_lib():
+    """Import tpukit/obs/metrics.py by path — `import tpukit` would pull
+    in jax, which this dashboard must not require."""
+    path = Path(__file__).resolve().parent.parent / "tpukit" / "obs" / "metrics.py"
+    spec = importlib.util.spec_from_file_location("tpukit_obs_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_log(path: str) -> list[dict]:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # torn final line from a live writer
+    return records
+
+
+def sparkline(values: list[float], width: int = 24) -> str:
+    """Map a series onto SPARK glyphs, resampled to `width` cells."""
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        # mean-pool into width cells so old history compresses, not drops
+        step = len(vals) / width
+        vals = [
+            sum(chunk) / len(chunk)
+            for i in range(width)
+            if (chunk := vals[int(i * step):max(int((i + 1) * step), int(i * step) + 1)])
+        ]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(SPARK[int((v - lo) / span * (len(SPARK) - 1))] for v in vals)
+
+
+def hist_sparkline(h, width: int = 24) -> str:
+    """The distribution's shape: bucket counts over the occupied bucket
+    range (log-spaced x axis for free — the edges are log-spaced;
+    h.buckets is the sparse {index: count} map)."""
+    if not h.buckets:
+        return ""
+    lo, hi = min(h.buckets), max(h.buckets) + 1
+    return sparkline([float(h.buckets.get(i, 0)) for i in range(lo, hi)], width)
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v * 1e3:.1f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
+def _fmt_count(n) -> str:
+    n = float(n)
+    for scale, suffix in ((1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f}{suffix}"
+    return f"{n:.0f}" if n == int(n) else f"{n:.2f}"
+
+
+def render(merged, meta: dict, metrics_lib, records: list[dict]) -> str:
+    out: list[str] = []
+    w = out.append
+    snap = merged.summary()
+    gauges = {(g["name"], tuple(sorted(g["labels"].items()))): g["value"]
+              for g in snap["gauges"]}
+
+    stale = f", {meta['stale']} stale" if meta.get("stale") else ""
+    torn = f", {meta['skipped']} torn" if meta.get("skipped") else ""
+    w(f"tpukit top — {meta.get('files', 0)} snapshot(s) merged{stale}{torn}"
+      f"   {time.strftime('%H:%M:%S')}")
+
+    # headline gauges: last-writer per label set; show the per-label rows
+    # when a fleet's replicas each set one
+    for name, label in (("fleet_tokens_per_sec", "fleet tokens/s"),
+                        ("serve_tokens_per_sec", "serve tokens/s"),
+                        ("train_tokens_per_sec", "train tokens/s")):
+        rows = [(dict(lk), v) for (n, lk), v in gauges.items() if n == name]
+        if rows:
+            cells = "  ".join(
+                (f"r{lab['replica']}=" if "replica" in lab else "")
+                + _fmt_count(v)
+                for lab, v in sorted(rows, key=lambda r: str(r[0])))
+            w(f"  {label:<16} {cells}")
+    occ_rows = []
+    for name, label in (("fleet_occupancy", "fleet occ"),
+                        ("serve_occupancy", "occupancy"),
+                        ("serve_page_occupancy", "page occ"),
+                        ("fleet_queue_depth", "queue"),
+                        ("serve_queue_depth", "queue"),
+                        ("fleet_replicas", "replicas")):
+        rows = [(dict(lk), v) for (n, lk), v in gauges.items() if n == name]
+        if not rows:
+            continue
+        cells = "  ".join(
+            (f"r{lab['replica']}=" if "replica" in lab else "")
+            + (f"{100 * v:.0f}%" if "occ" in name else _fmt_count(v))
+            for lab, v in sorted(rows, key=lambda r: str(r[0])))
+        occ_rows.append(f"{label} {cells}")
+    if occ_rows:
+        w("  " + "   ".join(occ_rows))
+
+    counters: dict[str, float] = {}
+    for c in snap["counters"]:
+        counters[c["name"]] = counters.get(c["name"], 0.0) + c["value"]
+    if counters:
+        w("  " + "  ".join(f"{n}={_fmt_count(v)}"
+                           for n, v in sorted(counters.items())))
+
+    names = merged.hist_names()
+    if names:
+        w(f"  {'histogram':<26} {'count':>7} {'p50':>9} {'p99':>9}  shape")
+        for name in names:
+            h = merged.aggregate_hist(name)
+            if h.count == 0:
+                continue
+            fmt = _fmt_s if name.endswith("_s") else _fmt_count
+            w(f"  {name:<26} {_fmt_count(h.count):>7} "
+              f"{fmt(h.quantile(0.5)):>9} {fmt(h.quantile(0.99)):>9}  "
+              f"{hist_sparkline(h)}")
+
+    # --log panels: SLO compliance/burn from the last kind="slo" row and
+    # a tokens/s-over-windows sparkline from the window records
+    if records:
+        slo_rows = [r for r in records if r.get("kind") == "slo"]
+        if slo_rows:
+            last = slo_rows[-1]
+            oc = last.get("overall_compliance")
+            w(f"  slo ({len(slo_rows)} windows): overall "
+              + (f"{100 * oc:.2f}%" if oc is not None else "no samples"))
+            for t in last.get("targets") or []:
+                cc, cb = t.get("cum_compliance"), t.get("cum_burn")
+                if cc is None:
+                    w(f"    {t.get('slo', '?'):<20} no samples")
+                    continue
+                w(f"    {t.get('slo', '?'):<20} {100 * cc:.2f}% "
+                  f"burn {cb:.2f}x"
+                  + ("" if cc >= (t.get("q") or 0) else "  <- VIOLATED"))
+        for kind in ("fleet", "serve", "train"):
+            tps = [r.get("tokens_per_sec") for r in records
+                   if r.get("kind") == kind and r.get("tokens_per_sec")]
+            if tps:
+                w(f"  {kind} tokens/s over windows: {sparkline(tps)} "
+                  f"(last {_fmt_count(tps[-1])})")
+                break
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dir", help="--metrics_dir of a live or finished run")
+    ap.add_argument("--log", default="",
+                    help="the run's --metrics_log JSONL: adds the SLO "
+                         "panel and the tokens/s-over-windows sparkline")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="redraw period in seconds (live mode)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit — the CI mode")
+    args = ap.parse_args(argv)
+
+    metrics_lib = _load_metrics_lib()
+    while True:
+        merged, meta = metrics_lib.merge_snapshot_dir(args.dir)
+        if not meta.get("files"):
+            print(f"{args.dir}: no metric snapshots (is the run started "
+                  f"with --metrics_dir, and not --no_metrics?)",
+                  file=sys.stderr)
+            return 1
+        records = load_log(args.log) if args.log else []
+        frame = render(merged, meta, metrics_lib, records)
+        if args.once:
+            print(frame)
+            return 0
+        # full clear + home, then the frame: flicker-free enough at 2s
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,14 @@ class TrainFlags:
     compilation_cache_dir: str = ""
     profile_dir: str = ""  # if set, jax.profiler traces land here
     metrics_log: str = ""  # if set, JSONL step metrics land here
+    # Metrics plane (round 22, tpukit/obs/metrics.py): mergeable
+    # counters + log-bucket histograms derived from the fit() window
+    # spans and the recovery observers — ON by default (pure observer,
+    # window-boundary host code only). --metrics_dir points at a SHARED
+    # directory where every process atomically publishes its snapshot
+    # file each window and process 0 merges by bucket sum.
+    no_metrics: bool = False
+    metrics_dir: str = ""
     # Debug toolchain (SURVEY §5 race-detection plan): aborts with a traceback
     # at the first NaN/Inf produced inside any jitted computation.
     debug_nans: bool = False
@@ -275,6 +283,9 @@ def build_parser(
     )
     parser.add_argument("--profile_dir", type=str, default=defaults.profile_dir)
     parser.add_argument("--metrics_log", type=str, default=defaults.metrics_log)
+    parser.add_argument("--no_metrics", action="store_true",
+                        default=defaults.no_metrics)
+    parser.add_argument("--metrics_dir", type=str, default=defaults.metrics_dir)
     parser.add_argument("--debug_nans", action="store_true")
     parser.add_argument("--log_grad_norms", action="store_true")
     parser.add_argument(
@@ -418,6 +429,28 @@ def add_serve_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "(oldest evicted; evictions break the trace-"
                         "completeness invariant on long runs — grow this "
                         "before gating with --min_trace_complete)")
+    # Metrics plane (round 22, tpukit/obs/metrics.py): ON by default —
+    # counters/gauges/log-bucket histograms DERIVED from completions,
+    # trace trees and quantum walls at window boundaries (the decode hot
+    # path is untouched), token streams bit-identical either way
+    # (tests/test_metrics.py) and <1% throughput (bench metrics_overhead).
+    parser.add_argument("--no_metrics", action="store_true",
+                        help="disable the metrics plane (mergeable "
+                        "latency histograms, kind=\"metrics\"/\"slo\" "
+                        "JSONL rows, snapshot files, tools/top.py feed)")
+    parser.add_argument("--slo", type=str, default="",
+                        help="declared service objectives, e.g. "
+                        "\"ttft<=250ms@p99;tpot<=40ms@p95;e2e<=2s@p99\" "
+                        "— parsed at startup (typos fail fast); each "
+                        "window emits per-target compliance + error-"
+                        "budget burn as kind=\"slo\" rows, gated by "
+                        "report.py --min_slo_compliance")
+    parser.add_argument("--metrics_dir", type=str, default="",
+                        help="shared directory for atomic per-process/"
+                        "per-replica metric snapshot files "
+                        "(metrics-pNNNNN.json, heartbeat-file "
+                        "discipline); process 0 publishes the bucket-"
+                        "summed merge + OpenMetrics textfile beside them")
     return parser
 
 

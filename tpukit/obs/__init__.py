@@ -10,6 +10,13 @@ pillars, one per module:
                    trees with phase walls (queue_wait/prefill/handoff/
                    decode/sync_stall), the completeness invariant and
                    the Chrome-trace exporter behind `tools/traceview.py`.
+  - `metrics`    — mergeable fleet metrics (round 22): counters, gauges
+                   and log-bucket histograms with ONE edge table
+                   everywhere (merge = bucket-wise sum, exact), SLO
+                   compliance + error-budget burn accounting, atomic
+                   per-process snapshot files merged by process 0, and
+                   the OpenMetrics textfile exporter behind
+                   `tools/top.py`.
   - `spans`      — `SpanTimeline`: host-phase wall-clock accounting and the
                    goodput breakdown (fraction of time inside the compiled
                    step vs data wait / H2D / checkpoint / eval).
@@ -48,6 +55,18 @@ from tpukit.obs.meter import (  # noqa: F401
     peak_flops_per_chip,
     profiler_trace,
     train_flops_per_token,
+)
+from tpukit.obs.metrics import (  # noqa: F401
+    Histogram,
+    MetricRegistry,
+    SloAccountant,
+    SloSpecError,
+    SloTarget,
+    merge_snapshot_dir,
+    parse_slo,
+    publish_snapshot,
+    to_openmetrics,
+    write_merged,
 )
 from tpukit.obs.recorder import FlightRecorder  # noqa: F401
 from tpukit.obs.trace import (  # noqa: F401

@@ -89,6 +89,7 @@ class TraceRecorder:
             raise ValueError(f"trace capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self._rings: dict = {}  # replica label -> deque
+        self._ring_totals: dict = {}  # replica label -> events ever emitted
         self._lock = threading.Lock()
         self._total = 0
         self._epoch: float | None = None
@@ -123,6 +124,7 @@ class TraceRecorder:
                 ring = self._rings[key] = deque(maxlen=self.capacity)
             ring.append(rec)
             self._total += 1
+            self._ring_totals[key] = self._ring_totals.get(key, 0) + 1
 
     def snapshot(self) -> list[dict]:
         """Consistent merged copy of every ring, time-sorted. Safe from
@@ -142,6 +144,20 @@ class TraceRecorder:
         incomplete and `--trace_capacity` should grow."""
         with self._lock:
             return self._total - sum(len(r) for r in self._rings.values())
+
+    @property
+    def dropped_by_replica(self) -> dict:
+        """Per-ring eviction counts, keyed by the emitting replica label
+        (None for a standalone engine), only nonzero entries — the
+        summary/report surface that stops a saturated ring from silently
+        reading as a complete history (a dropped event poisons every
+        phase aggregate built from the ring)."""
+        with self._lock:
+            return {
+                key: self._ring_totals.get(key, 0) - len(ring)
+                for key, ring in self._rings.items()
+                if self._ring_totals.get(key, 0) > len(ring)
+            }
 
     def __len__(self) -> int:
         with self._lock:

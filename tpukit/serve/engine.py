@@ -52,6 +52,7 @@ from jax.sharding import PartitionSpec as P
 
 from tpukit.model import gpt
 from tpukit.obs import SpanTimeline
+from tpukit.obs import metrics as metrics_lib
 from tpukit.obs import trace as trace_lib
 from tpukit.serve import decode as serve_decode
 
@@ -385,7 +386,7 @@ class ServeEngine:
     def __init__(self, params, cfg: gpt.GPTConfig, serve: ServeConfig,
                  eos_id: int, mesh=None, logger=None, recorder=None,
                  draft_params=None, draft_cfg=None, replica=None,
-                 tracer=None):
+                 tracer=None, metrics=None, slo=None, metrics_dir=None):
         if serve.kv_width > cfg.max_position_embeddings:
             raise ValueError(
                 f"KV ring width {serve.kv_width} (max bucket "
@@ -454,6 +455,23 @@ class ServeEngine:
         # token stream and schedule are bit-identical either way
         # (asserted in tests/test_trace.py).
         self.tracer = tracer
+        # Metrics plane (round 22, tpukit/obs/metrics.py): a shared
+        # MetricRegistry observed at WINDOW boundaries only — every
+        # histogram is DERIVED from the completions / trace trees /
+        # quantum events the engine already produces, so the step
+        # primitives and the token stream are bit-identical with
+        # metrics on or off (asserted in tests/test_metrics.py).
+        # `slo` is a list of parsed SloTargets (metrics_lib.parse_slo);
+        # a fleet passes slo=None to its replicas and accounts SLOs at
+        # the router, mirroring the shared-tracer flush discipline.
+        self.metrics = metrics
+        self.slo_accountant = (
+            metrics_lib.SloAccountant(slo)
+            if (metrics is not None and slo) else None
+        )
+        self.metrics_dir = metrics_dir
+        self._metrics_traces_seen: set = set()
+        self._metrics_q_mark = -1.0  # quantum watermark (t1 run-clock)
         self._pending_quantum = None  # dispatch half of the quantum event
         # fused windows (round 21): the device tick counter of the last
         # decode_loop_window dispatch, fetched at the window-boundary sync
@@ -1137,6 +1155,8 @@ class ServeEngine:
                 new_tokens=new_tokens, occupancy=rec["occupancy"],
                 completed=len(comps),
             )
+        if self.metrics is not None:
+            self._metrics_window(comps, rec)
         self._window_idx += 1
         self._win = dict(
             steps=0, gen0=self._gen_total, admit0=self.admitted,
@@ -1145,6 +1165,86 @@ class ServeEngine:
             prop0=self.spec_proposed, acc0=self.spec_accepted,
             hist0=list(self.spec_hist),
         )
+
+    def _metrics_window(self, comps, rec: dict) -> None:
+        """Fold one window into the metric registry and account the
+        declared SLOs — pure derivation from already-produced data
+        (completions, trace trees, quantum events); the step primitives
+        never see this code."""
+        m = self.metrics
+        rep = self.replica
+        # per-completion latency histograms + deterministic counters.
+        # ttft = arrival -> decode-ready (queue wait + prefill +
+        # handoff), the trace-tree partition read off the Completion
+        # timestamps the engine already stamps.
+        for c in comps:
+            m.observe("serve_e2e_s", c.e2e_s, replica=rep)
+            m.observe("serve_ttft_s", max(c.active_s - c.arrival_s, 0.0),
+                      replica=rep)
+            m.observe("serve_queue_wait_s", max(c.admit_s - c.arrival_s, 0.0),
+                      replica=rep)
+            m.observe("serve_tpot_s", c.per_token_s, replica=rep)
+            m.observe("serve_tokens_per_request", c.generated, replica=rep)
+            m.inc("serve_requests", 1, replica=rep, reason=c.reason)
+            m.inc("serve_tokens", c.generated, replica=rep)
+        # window gauges (point-in-time; replica-labeled so merges keep
+        # every replica's latest)
+        if rec.get("tokens_per_sec") is not None:
+            m.gauge("serve_tokens_per_sec", rec["tokens_per_sec"], replica=rep)
+        m.gauge("serve_occupancy", rec["occupancy"], replica=rep)
+        m.gauge("serve_queue_depth", rec["queue_depth"], replica=rep)
+        if self.serve.paged:
+            m.gauge("serve_page_occupancy", rec["page_occupancy"], replica=rep)
+        if self.tracer is not None:
+            # phase walls from newly-closed span trees (trees are cheap
+            # to rebuild at window cadence; the seen-set keeps each
+            # request observed exactly once even though the ring is
+            # fleet-shared)
+            rids = {c.rid for c in self.completions}
+            for t in trace_lib.build_trees(self.tracer.snapshot()):
+                if (t["trace"] in self._metrics_traces_seen
+                        or not t["closed"] or t["rid"] not in rids):
+                    continue
+                self._metrics_traces_seen.add(t["trace"])
+                for ph, wall in t["phases"].items():
+                    m.observe("serve_phase_s", wall, replica=rep, phase=ph)
+            # per-quantum dispatch-vs-sync walls, watermarked so each
+            # quantum lands once (events are time-sorted by snapshot())
+            mark = self._metrics_q_mark
+            for ev in self.tracer.snapshot():
+                if (ev.get("ev") != "quantum"
+                        or ev.get("replica") != rep
+                        or ev.get("t1", 0.0) <= mark):
+                    continue
+                self._metrics_q_mark = max(self._metrics_q_mark, ev["t1"])
+                m.observe("serve_dispatch_s", ev["t1"] - ev["t0"],
+                          replica=rep, phase="dispatch")
+                if "s1" in ev:
+                    m.observe("serve_sync_s", ev["s1"] - ev["s0"],
+                              replica=rep, phase="sync")
+        if self.slo_accountant is not None:
+            samples = {
+                "e2e": [c.e2e_s for c in comps],
+                "ttft": [max(c.active_s - c.arrival_s, 0.0) for c in comps],
+                "queue_wait": [max(c.admit_s - c.arrival_s, 0.0) for c in comps],
+                "tpot": [c.per_token_s for c in comps],
+            }
+            slo_rec = dict(kind="slo", window=self._window_idx,
+                           **self.slo_accountant.evaluate(samples))
+            if self.replica is not None:
+                slo_rec["replica"] = self.replica
+            if self.logger is not None:
+                self.logger.log(**slo_rec)
+            if self.recorder is not None:
+                self.recorder.record(
+                    "slo", window=self._window_idx,
+                    overall_compliance=slo_rec["overall_compliance"],
+                )
+        if self.metrics_dir:
+            metrics_lib.publish_snapshot(
+                self.metrics_dir, self.replica or 0, m,
+                time_s=time.time(),
+            )
 
     def summary(self, wall_s: float) -> dict:
         comps = self.completions
@@ -1223,6 +1323,16 @@ class ServeEngine:
                      if t["rid"] in rids]
             rec["phase_p50"], rec["phase_p99"] = trace_lib.phase_stats(trees)
             rec["trace_complete"] = trace_lib.completeness(trees)
+            # ring evictions poison every aggregate above — surface them
+            # instead of letting a saturated ring read as complete
+            # (report.py warns when nonzero)
+            rec["trace_dropped"] = self.tracer.dropped_by_replica.get(
+                self.replica, 0
+            )
+        if self.slo_accountant is not None:
+            rec["slo_overall_compliance"] = (
+                self.slo_accountant.overall_compliance()
+            )
         return rec
 
     # ---- step primitives (the fleet hooks, round 19) ---------------------
@@ -1324,6 +1434,27 @@ class ServeEngine:
                 self.tracer, self.logger,
                 trace_lib.build_trees(self.tracer.snapshot()),
             )
+        if self.metrics is not None and self.replica is None:
+            # standalone metrics epilogue (a fleet's router owns this,
+            # same ownership rule as the tracer flush above): the
+            # kind="metrics" summary row plus the snapshot-file merge
+            rec_m = dict(kind="metrics", source="serve",
+                         **self.metrics.summary())
+            if self.logger is not None:
+                self.logger.log(**rec_m)
+            if self.recorder is not None:
+                self.recorder.record(
+                    "metrics", source="serve",
+                    hists=len(rec_m["hists"]),
+                    tokens=self.metrics.sum_counter("serve_tokens"),
+                )
+            if self.metrics_dir:
+                metrics_lib.publish_snapshot(
+                    self.metrics_dir, self.replica or 0, self.metrics,
+                    time_s=time.time(),
+                )
+                merged, meta = metrics_lib.merge_snapshot_dir(self.metrics_dir)
+                metrics_lib.write_merged(self.metrics_dir, merged, meta=meta)
         return self.completions
 
     def requeue_live(self) -> list[Request]:

@@ -72,6 +72,7 @@ from collections import deque
 import numpy as np
 
 from tpukit import chaos as chaos_lib
+from tpukit.obs import metrics as metrics_lib
 from tpukit.obs import trace as trace_lib
 from tpukit.serve import paged as paged_lib
 from tpukit.serve.engine import (
@@ -250,7 +251,8 @@ class FleetRouter:
 
     def __init__(self, params_host, cfg, serve: ServeConfig,
                  fleet: FleetConfig, eos_id: int, *, devices=None,
-                 logger=None, recorder=None, tracer=None):
+                 logger=None, recorder=None, tracer=None, metrics=None,
+                 slo=None, metrics_dir=None):
         import jax
 
         if serve.draft and fleet.disagg_prefill:
@@ -282,6 +284,20 @@ class FleetRouter:
         # clock and ring set that survives replica kills, so the router
         # owns it and flushes it once at fleet shutdown.
         self.tracer = tracer
+        # ONE MetricRegistry shared the same way (round 22): every
+        # replica engine observes into it replica-labeled, the router
+        # accounts the fleet-level SLOs and owns the snapshot-file
+        # publish/merge — per-replica files split out of the shared
+        # registry by label, process-0-merges them back by bucket sum
+        # (the proof harness for ROADMAP #1's cross-process metrics).
+        self.metrics = metrics
+        self.slo_accountant = (
+            metrics_lib.SloAccountant(slo)
+            if (metrics is not None and slo) else None
+        )
+        self.metrics_dir = metrics_dir
+        self._slo_seen_rids: set = set()
+        self._metrics_replicas: set = set()  # every replica id ever built
         self._params_host = params_host
         self.placements = 0
         self._placed: dict[int, object] = {}  # subset idx -> placed params
@@ -380,8 +396,10 @@ class FleetRouter:
             self._place_for(mesh, subset_idx=idx), self.cfg, self.serve,
             eos_id=self.eos_id, mesh=mesh, logger=self.logger,
             recorder=self.recorder, replica=idx, tracer=self.tracer,
+            metrics=self.metrics,
         )
         self._replicas[idx] = eng
+        self._metrics_replicas.add(idx)
         self.replicas_peak = max(self.replicas_peak, len(self._replicas))
         if log:
             self._event("scale_up", replica=idx,
@@ -620,10 +638,71 @@ class FleetRouter:
                 "fleet", window=self._window_idx, new_tokens=tok,
                 occupancy=occ, replicas=len(self._replicas),
             )
+        if self.metrics is not None:
+            self._metrics_window(rec)
         self._window_idx += 1
         self._win = dict(rounds=0, occ=0.0, tok0=self._fleet_gen(), t0=now,
                          req0=self.requeued)
         return occ
+
+    def _metrics_window(self, rec: dict) -> None:
+        """Fleet-level metrics + SLO accounting for one window, derived
+        from data the loop already produced (the replica engines observe
+        their own per-completion histograms replica-labeled into the
+        SAME shared registry)."""
+        m = self.metrics
+        if rec.get("tokens_per_sec") is not None:
+            m.gauge("fleet_tokens_per_sec", rec["tokens_per_sec"])
+        m.gauge("fleet_occupancy", rec["occupancy"])
+        m.gauge("fleet_queue_depth", rec["queue_depth"])
+        m.gauge("fleet_replicas", len(self._replicas))
+        if self.slo_accountant is not None:
+            # fleet-wide SLO samples: every completion not yet
+            # accounted, wherever it lives (live engines or the retired
+            # ledger) — exactly-once by rid, the _done dedup invariant
+            fresh: list[Completion] = []
+            pools = [e.completions for e in self._replicas.values()]
+            pools.append(self._done)
+            for pool in pools:
+                for c in pool:
+                    if c.rid not in self._slo_seen_rids:
+                        self._slo_seen_rids.add(c.rid)
+                        fresh.append(c)
+            samples = {
+                "e2e": [c.e2e_s for c in fresh],
+                "ttft": [max(c.active_s - c.arrival_s, 0.0) for c in fresh],
+                "queue_wait": [max(c.admit_s - c.arrival_s, 0.0)
+                               for c in fresh],
+                "tpot": [c.per_token_s for c in fresh],
+            }
+            slo_rec = dict(kind="slo", window=self._window_idx,
+                           **self.slo_accountant.evaluate(samples))
+            if self.logger is not None:
+                self.logger.log(**slo_rec)
+            if self.recorder is not None:
+                self.recorder.record(
+                    "slo", window=self._window_idx,
+                    overall_compliance=slo_rec["overall_compliance"],
+                )
+        if self.metrics_dir:
+            self._publish_metrics()
+
+    def _publish_metrics(self) -> None:
+        """Per-replica snapshot files split from the shared registry by
+        label (heartbeat-file discipline: one atomic file per publisher)
+        plus the router's process-0 merge beside them."""
+        wall = time.time()
+        count = self.fleet.max_count
+        for idx in sorted(self._metrics_replicas):
+            metrics_lib.publish_snapshot(
+                self.metrics_dir, idx,
+                self.metrics.filter(replica=idx),
+                process_count=count, time_s=wall,
+            )
+        merged, meta = metrics_lib.merge_snapshot_dir(
+            self.metrics_dir, process_count=count
+        )
+        metrics_lib.write_merged(self.metrics_dir, merged, meta=meta)
 
     def summary(self, wall_s: float) -> dict:
         comps = self._done
@@ -672,6 +751,18 @@ class FleetRouter:
                      if t["rid"] in done_rids]
             rec["phase_p50"], rec["phase_p99"] = trace_lib.phase_stats(trees)
             rec["trace_complete"] = trace_lib.completeness(trees)
+            # per-ring evictions (round 22): a saturated ring silently
+            # reads as a complete history otherwise — report.py warns
+            # when nonzero
+            by_rep = self.tracer.dropped_by_replica
+            rec["trace_dropped"] = sum(by_rep.values())
+            rec["trace_dropped_by_replica"] = {
+                str(k): v for k, v in sorted(by_rep.items(), key=str)
+            }
+        if self.slo_accountant is not None:
+            rec["slo_overall_compliance"] = (
+                self.slo_accountant.overall_compliance()
+            )
         return rec
 
     # ---- the loop --------------------------------------------------------
@@ -769,6 +860,23 @@ class FleetRouter:
                 self.tracer, self.logger,
                 trace_lib.build_trees(self.tracer.snapshot()),
             )
+        if self.metrics is not None:
+            # one metrics epilogue for the whole fleet (replica engines
+            # share this registry and skip their own — ServeEngine.finish
+            # only emits when replica is None): the kind="metrics"
+            # summary row plus the final snapshot publish/merge
+            rec_m = dict(kind="metrics", source="fleet",
+                         **self.metrics.summary())
+            if self.logger is not None:
+                self.logger.log(**rec_m)
+            if self.recorder is not None:
+                self.recorder.record(
+                    "metrics", source="fleet",
+                    hists=len(rec_m["hists"]),
+                    tokens=self.metrics.sum_counter("serve_tokens"),
+                )
+            if self.metrics_dir:
+                self._publish_metrics()
         self._done.sort(key=lambda c: c.done_s)
         return self._done
 

@@ -37,6 +37,7 @@ import contextlib
 import dataclasses
 import functools
 import os
+import time
 from functools import partial
 from typing import Any, Callable
 
@@ -73,6 +74,7 @@ from tpukit.obs import (
     FlightRecorder,
     HangWatchdog,
     Heartbeat,
+    MetricRegistry,
     MFUMeter,
     SpanTimeline,
     SpikeSentinel,
@@ -84,7 +86,10 @@ from tpukit.obs import (
     global_norms,
     live_memory_stats,
     make_state_checksum,
+    merge_snapshot_dir,
     profiler_trace,
+    publish_snapshot,
+    write_merged,
 )
 from tpukit.sampling import generate_batch
 from tpukit.shardings import Strategy
@@ -736,6 +741,29 @@ def _fit_body(
     # dumped. The cost is one dict + deque append per step (<1% of any
     # real step; bench.py's obs_overhead record audits it).
     recorder = FlightRecorder()
+    # Metrics plane (round 22): mergeable counters/gauges/log-bucket
+    # histograms derived from telemetry the loop ALREADY computes (the
+    # window spans, the MFU meter, the recovery observers) — never a new
+    # sync or wall read on the hot path. Pure observer: --no_metrics must
+    # not change a single token (bench.py's metrics_overhead record
+    # asserts bit-identity and <1% throughput cost).
+    metric_reg = None if flags.no_metrics else MetricRegistry()
+
+    def publish_metrics(final: bool = False) -> None:
+        """Atomic per-process snapshot into --metrics_dir (heartbeat-file
+        discipline: every process writes its own file, process 0 merges).
+        Window cadence, so tools/top.py can tail a live run."""
+        if metric_reg is None or not flags.metrics_dir:
+            return
+        nproc = jax.process_count()
+        publish_snapshot(
+            flags.metrics_dir, jax.process_index(), metric_reg,
+            process_count=nproc, time_s=time.time(),
+        )
+        if p0:
+            merged, meta = merge_snapshot_dir(flags.metrics_dir, nproc)
+            write_merged(flags.metrics_dir, merged, meta=meta)
+
     if resize_event is not None:
         # the elastic restore happened before the logger existed; surface
         # it now so the JSONL (and tools/report.py) names the topology
@@ -1052,6 +1080,8 @@ def _fit_body(
         for ev in retry_log.drain():
             logger.log(kind="retry", step=host_step, **ev)
             recorder.record("retry", step=host_step, **ev)
+            if metric_reg is not None:
+                metric_reg.inc("train_retries")
         if chaos_engine is not None:
             for ev in chaos_engine.drain_fired():
                 rec = dict(ev)
@@ -1148,9 +1178,12 @@ def _fit_body(
             kind="preempt", step=host_step, signal=sig, epoch=ep,
             batch_in_epoch=nb, checkpoint=str(path),
         )
+        if metric_reg is not None:
+            metric_reg.inc("train_preempts")
         if heart is not None:
             heart.beat(host_step, timeline=timeline)
         drain_side_events()
+        publish_metrics()  # last snapshot before the exit below
         if p0:
             print(f"preempted by {sig} at step {host_step}; checkpoint {path}")
         logger.close()
@@ -1171,6 +1204,7 @@ def _fit_body(
                 # abort must leave a DURABLE autopsy
                 async_saver.wait()
         drain_side_events()
+        publish_metrics()  # the autopsy snapshot: counters up to the abort
         # (the raise unwinds through _cleanup, which closes this epoch's
         # prefetcher and bar)
         logger.close()
@@ -1237,6 +1271,8 @@ def _fit_body(
         rec = plan.record()
         logger.log(kind="rollback", timeline=timeline, quarantined=quarantined, **rec)
         recorder.record("rollback", **rec)
+        if metric_reg is not None:
+            metric_reg.inc("train_rollbacks")
         if heart is not None:
             heart.beat(host_step, timeline=timeline)
         if p0:
@@ -1592,6 +1628,24 @@ def _fit_body(
                         goodput=win["goodput"],
                         window_s=round(win["total_s"], 6),
                     )
+                    if metric_reg is not None:
+                        # Goodput-component walls: the window's per-span
+                        # seconds the timeline already measured, one
+                        # histogram per phase (step/data/h2d/sync/...).
+                        for _ph, _secs in win["seconds"].items():
+                            if _secs > 0:
+                                metric_reg.observe(
+                                    "train_span_s", _secs, phase=_ph
+                                )
+                        metric_reg.observe("train_window_s", win["total_s"])
+                        metric_reg.gauge("train_goodput", win["goodput"])
+                        metric_reg.gauge(
+                            "train_tokens_per_sec", meter.tokens_per_sec
+                        )
+                        if meter.mfu:
+                            metric_reg.gauge("train_mfu", meter.mfu)
+                        metric_reg.inc("train_windows")
+                        publish_metrics()
                     if (
                         watchdog is not None
                         and len(watchdog.hang_events) > hangs_logged
@@ -1925,6 +1979,18 @@ def _fit_body(
     # (validation/generation loader fetches) and the final save above — must
     # reach the JSONL before the logger closes.
     drain_side_events()
+    if metric_reg is not None:
+        # Metrics epilogue (round 22): one kind="metrics" summary record —
+        # cumulative counters + per-histogram count/sum/p50/p99 — so
+        # tools/report.py --compare can diff two runs without replaying
+        # every window. The final snapshot publish lands the complete run
+        # in --metrics_dir for external scrapers.
+        rec_m = dict(kind="metrics", source="train", **metric_reg.summary())
+        logger.log(**rec_m)
+        recorder.record(
+            "metrics", source="train", hists=len(rec_m.get("hists", {})),
+        )
+        publish_metrics()
     if cache_stats is not None and p0:
         cs = cache_stats.stats()
         logger.log(kind="compile_cache", **cs)

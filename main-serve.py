@@ -76,6 +76,26 @@ measures the kernel and amortization wins separately and
 `tools/report.py --min_decode_speedup` gates the latter. Needs the
 paged cache (`--page_size`).
 
+Round 24 (tpukit/serve/ledger.py): CRASH-TOLERANT fleet serving. With
+`--fleet_dir` the request lifecycle is durable — write-ahead lease
+records before dispatch, exactly-once completion records after, full
+stream replay on router restart (a restarted router serves only the
+not-yet-completed frontier; `duplicate_completions` stays 0 across
+process death). Replicas publish heartbeat files; `--replica_timeout`
+declares silent replicas dead and requeues their leases on survivors
+under the `--request_retries` budget with jittered backoff.
+`--fleet_procs` runs each replica as a real worker PROCESS (this recipe
+re-exec'd with `--fleet_worker i`) so `--fleet_kill
+replica_sigkill@R` chaos delivers a real SIGKILL; the serving chaos
+grammar also takes slow_replica@R:ms (heartbeat stall — slowness the
+liveness check must NOT confuse with death), stuck_request@N (pair with
+`--deadline_ms`), and ledger_io_fail@k:c (transient IOError on ledger
+I/O, absorbed by retry_io). `--deadline_ms` evicts over-deadline lanes
+with their partial tokens as reason="deadline" (kind="deadline_miss"
+records, gated by report.py --max_deadline_miss_pct);
+`--max_queue_depth` sheds over-depth arrivals lowest-priority-first as
+named request_rejected events.
+
 Run examples:
   python main-serve.py --requests 64 --slots 8 --metrics_log serve.jsonl
   python main-serve.py --checkpoint latest --temperature 0.8 --top_k 40
@@ -91,6 +111,9 @@ Run examples:
   python main-serve.py --replicas 2 --devices_per_replica 4 \\
       --fleet_kill replica_kill@40:1 \\
       --metrics_log fleet.jsonl   # fleet router + chaos replica kill
+  python main-serve.py --replicas 2 --fleet_procs --fleet_dir /tmp/fleet \\
+      --replica_timeout 3 --fleet_kill replica_sigkill@6:1 \\
+      --metrics_log fleet.jsonl   # real worker procs + real SIGKILL
 """
 
 import argparse
@@ -474,6 +497,142 @@ def main(argv=None):
     return 0
 
 
+def _apply_request_knobs(requests, flags):
+    """Apply the stream-wide request robustness knobs (round 24):
+    `--deadline_ms` stamps every synthetic request with a completion
+    deadline (the engine evicts over-deadline lanes with their partial
+    tokens as reason=\"deadline\")."""
+    if not flags.deadline_ms:
+        return requests
+    import dataclasses
+
+    return [dataclasses.replace(r, deadline_ms=flags.deadline_ms)
+            for r in requests]
+
+
+def _run_fleet_worker(flags, cfg, tokenizer, buckets) -> int:
+    """INTERNAL (`--fleet_worker N`, set by the --fleet_procs supervisor
+    re-execing this recipe): run ONE replica engine as a real process
+    driven entirely through the durable ledger under `--fleet_dir` —
+    claim leases addressed to this replica, decode, publish exactly-once
+    completion records, beat the heartbeat file, exit on the
+    supervisor's stop record. The worker does its OWN params cold start
+    (processes share no memory; the ledger directory is the only
+    channel) and never writes the supervisor's JSONL."""
+    import jax
+    from functools import partial
+
+    from tpukit import checkpoint as ckpt_lib
+    from tpukit.serve import ServeConfig, ServeEngine, serve_from_ledger
+    from tpukit.serve.fleet import place_replica_params
+    from tpukit.shardings import SingleDevice
+    from tpukit.train import create_train_state, make_optimizer
+
+    serve = ServeConfig(
+        slots=flags.slots, buckets=buckets,
+        max_new_tokens=flags.max_new_tokens,
+        temperature=flags.temperature, top_k=flags.top_k,
+        window_steps=flags.window_steps,
+        decode_quantum=flags.decode_quantum,
+        page_size=flags.page_size, num_pages=flags.num_pages,
+        kv_dtype=flags.kv_dtype, prefill_chunk=flags.prefill_chunk,
+        draft=flags.draft, spec_k=flags.spec_k, ngram_max=flags.ngram_max,
+        fused_decode=flags.fused_decode,
+    )
+    optimizer = make_optimizer(1e-4)
+    init_fn = partial(create_train_state, cfg=cfg, optimizer=optimizer,
+                      strategy=SingleDevice())
+    state_shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(flags.seed))
+    if flags.checkpoint:
+        path = (ckpt_lib.latest_any() if flags.checkpoint == "latest"
+                else flags.checkpoint)
+        if path is None:
+            raise FileNotFoundError("--checkpoint latest: no checkpoint found")
+        params_host, _ = ckpt_lib.restore_params(
+            path, state_shapes.params, None
+        )
+        params = place_replica_params(params_host, None)
+    else:
+        params = jax.jit(lambda r: init_fn(r).params)(
+            jax.random.PRNGKey(flags.seed)
+        )
+    engine = ServeEngine(
+        params, cfg, serve, eos_id=int(tokenizer.eos_token_id), mesh=None,
+        logger=None, recorder=None, replica=flags.fleet_worker,
+    )
+    comps = serve_from_ledger(engine, flags.fleet_dir, flags.fleet_worker)
+    print(f"fleet worker {flags.fleet_worker}: {len(comps)} completion(s) "
+          f"published")
+    return 0
+
+
+def _run_fleet_procs(flags, cfg, tokenizer, buckets) -> int:
+    """Process fleet (`--fleet_procs`, round 24): each replica is a real
+    worker PROCESS (this recipe re-exec'd with `--fleet_worker i`)
+    coordinated only through the durable ledger under `--fleet_dir`.
+    `--fleet_kill replica_sigkill@R` delivers a REAL SIGKILL mid-stream;
+    liveness (process exit + heartbeat age) revokes the victim's leases
+    and requeues its in-flight requests on survivors with the
+    `--request_retries` budget — the crash-consistency claim the
+    in-process router can only simulate."""
+    import os
+    import subprocess
+
+    from tpukit import chaos as chaos_lib
+    from tpukit.obs import FlightRecorder, StepLogger
+    from tpukit.serve import ProcessFleet, synthetic_request_stream
+
+    if not flags.fleet_dir:
+        raise ValueError(
+            "--fleet_procs requires --fleet_dir: the ledger directory is "
+            "the only channel between supervisor and worker processes"
+        )
+    logger = StepLogger(flags.metrics_log)
+    recorder = FlightRecorder()
+
+    def spawn(idx):
+        argv = ([sys.executable, sys.argv[0]] + list(sys.argv[1:])
+                + ["--fleet_worker", str(idx)])
+        return subprocess.Popen(argv, env=dict(os.environ))
+
+    requests = _apply_request_knobs(
+        synthetic_request_stream(
+            tokenizer, flags.requests, seed=flags.seed,
+            max_new_tokens=flags.max_new_tokens, buckets=buckets,
+            qps=flags.qps, shared_prefix=flags.shared_prefix,
+            stream_profile=flags.stream_profile,
+        ),
+        flags,
+    )
+    pf = ProcessFleet(
+        flags.fleet_dir, spawn=spawn, replicas=flags.replicas,
+        replica_timeout=flags.replica_timeout or 5.0,
+        request_retries=flags.request_retries,
+        chaos=chaos_lib.ServingChaos(flags.fleet_kill),
+        logger=logger, recorder=recorder,
+    )
+    rec = pf.run(requests)
+    print(f"process fleet served {rec['requests']} requests / "
+          f"{rec['generated_tokens']} tokens in {rec['wall_s']:.2f}s over "
+          f"{flags.replicas} worker process(es)")
+    if rec["replicas_dead"] or rec["kills"]:
+        print(f"  failures: {rec['kills']} SIGKILL(s), "
+              f"{rec['replicas_dead']} replica death(s), "
+              f"{rec['leases_revoked']} lease(s) revoked, "
+              f"{rec['requeued']} request(s) re-queued, "
+              f"{rec['duplicate_completions']} duplicate completion(s)")
+    if rec["request_failures"] or rec["deadline_misses"]:
+        print(f"  requests: {rec['request_failures']} terminal failure(s), "
+              f"{rec['deadline_misses']} deadline miss(es)")
+    if rec["retry_total"]:
+        print(f"  {rec['retry_total']} transient I/O error(s) retried")
+    if flags.metrics_log:
+        print(f"fleet telemetry -> {flags.metrics_log} "
+              f"(render: python tools/report.py {flags.metrics_log})")
+    logger.close()
+    return 0
+
+
 def _run_fleet(flags, cfg, tokenizer, buckets) -> int:
     """Fleet serving (round 19, ROADMAP #1): route the stream over
     `--replicas` ServeEngine replicas on disjoint device subsets via
@@ -482,7 +641,13 @@ def _run_fleet(flags, cfg, tokenizer, buckets) -> int:
     ONCE into host arrays, and every replica placement is a device_put of
     that one copy — the `kind="ckpt_restore"` ledger records bytes_read
     once with the placement count alongside, so N replicas never imply
-    N checkpoint reads."""
+    N checkpoint reads. Round 24 adds the crash-tolerance plane: worker
+    (`--fleet_worker`) and process-fleet (`--fleet_procs`) modes dispatch
+    before the in-process router below."""
+    if flags.fleet_worker >= 0:
+        return _run_fleet_worker(flags, cfg, tokenizer, buckets)
+    if flags.fleet_procs:
+        return _run_fleet_procs(flags, cfg, tokenizer, buckets)
     import time
     from functools import partial
 
@@ -535,6 +700,10 @@ def _run_fleet(flags, cfg, tokenizer, buckets) -> int:
         disagg_prefill=flags.disagg_prefill,
         prefill_slots=flags.prefill_slots, prefill_pages=flags.prefill_pages,
         kill_spec=flags.fleet_kill,
+        fleet_dir=flags.fleet_dir,
+        replica_timeout=flags.replica_timeout,
+        request_retries=flags.request_retries,
+        max_queue_depth=flags.max_queue_depth,
     )
     logger = StepLogger(flags.metrics_log)
     recorder = FlightRecorder()
@@ -605,11 +774,14 @@ def _run_fleet(flags, cfg, tokenizer, buckets) -> int:
                   f"{flags.replicas} replica(s)"
                   + (" + prefill worker" if fleet.disagg_prefill else ""))
 
-    requests = synthetic_request_stream(
-        tokenizer, flags.requests, seed=flags.seed,
-        max_new_tokens=flags.max_new_tokens, buckets=buckets, qps=flags.qps,
-        shared_prefix=flags.shared_prefix,
-        stream_profile=flags.stream_profile,
+    requests = _apply_request_knobs(
+        synthetic_request_stream(
+            tokenizer, flags.requests, seed=flags.seed,
+            max_new_tokens=flags.max_new_tokens, buckets=buckets,
+            qps=flags.qps, shared_prefix=flags.shared_prefix,
+            stream_profile=flags.stream_profile,
+        ),
+        flags,
     )
     t0 = time.perf_counter()
     completions = router.run(requests)
@@ -623,10 +795,23 @@ def _run_fleet(flags, cfg, tokenizer, buckets) -> int:
               f"{s.get('replicas_final', '?')} replica(s) "
               f"(peak {s.get('replicas_peak', '?')})")
         if s.get("kills") or s.get("requeued"):
-            print(f"  failures: {s.get('kills', 0)} replica kill(s), "
+            print(f"  failures: {s.get('kills', 0)} replica kill(s) "
+                  f"({s.get('replicas_dead', 0)} by liveness), "
+                  f"{s.get('leases_revoked', 0)} lease(s) revoked, "
                   f"{s.get('requeued', 0)} request(s) re-queued, "
                   f"{s.get('duplicate_completions', 0)} duplicate "
                   f"completion(s)")
+        if (s.get("deadline_misses") or s.get("rejected")
+                or s.get("request_failures")):
+            print(f"  requests: {s.get('deadline_misses', 0)} deadline "
+                  f"miss(es), {s.get('rejected', 0)} shed by backpressure, "
+                  f"{s.get('request_failures', 0)} terminal failure(s)")
+        if s.get("ledger"):
+            led = s["ledger"]
+            print(f"  ledger: {led.get('completed', 0)} durable completion "
+                  f"record(s), {led.get('replayed', 0)} replayed, "
+                  f"{led.get('duplicates', 0)} duplicate(s) "
+                  f"-> {flags.fleet_dir}")
         if s.get("scale_ups") or s.get("scale_downs"):
             print(f"  autoscale: {s.get('scale_ups', 0)} up / "
                   f"{s.get('scale_downs', 0)} down")

@@ -25,7 +25,12 @@ Contracts pinned here:
     violations.
 """
 
+import dataclasses
 import json
+import os
+import subprocess
+import sys
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -455,6 +460,355 @@ def test_fleet_config_validation(tok, cfg, host_params):
     with pytest.raises(ValueError, match="meshless"):
         FleetRouter(host_params, moe, serve_ring,
                     FleetConfig(replicas=2, devices_per_replica=2), eos_id=1)
+
+
+# ---------------------------------------------------------------------------
+# Crash tolerance (round 24): durable ledger + real-process SIGKILL,
+# slow-vs-dead liveness discrimination, request deadlines, backpressure,
+# ledger replay, and the serving chaos grammar.
+# ---------------------------------------------------------------------------
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_process_fleet_sigkill_requeues_and_parity(tok, cfg, params,
+                                                   tmp_path):
+    """THE round-24 acceptance: a real worker process SIGKILLed mid-stream
+    loses nothing — its leases revoke, its requests requeue onto the
+    survivor, and the durable completion set is token-identical to an
+    unkilled single engine with ZERO duplicate completions across real
+    process death."""
+    from tpukit.obs import StepLogger
+    from tpukit.serve.ledger import ProcessFleet
+
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        window_steps=8)
+    reqs = synthetic_request_stream(tok, 8, seed=3, max_new_tokens=MAX_NEW,
+                                    buckets=(8, 16))
+    want = _single_engine_tokens(params, cfg, tok, serve, reqs)
+    log = tmp_path / "procs.jsonl"
+    logger = StepLogger(str(log))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def spawn(idx):
+        return subprocess.Popen(
+            [sys.executable, str(REPO / "tests" / "fleet_worker.py"),
+             str(tmp_path / "fleet"), str(idx)],
+            cwd=str(REPO), env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    fleet = ProcessFleet(tmp_path / "fleet", spawn=spawn, replicas=2,
+                         replica_timeout=60.0, request_retries=3,
+                         chaos=chaos_lib.ServingChaos("replica_sigkill@3:1"),
+                         logger=logger)
+    s = fleet.run(list(reqs), max_wall_s=240.0)
+    logger.close()
+    got = {rid: list(map(int, rec["ids"]))
+           for rid, rec in fleet.ledger.completions().items()}
+    assert got.keys() == want.keys()
+    for rid in want:
+        np.testing.assert_array_equal(got[rid], want[rid], err_msg=f"rid {rid}")
+    assert s["kills"] == 1 and s["replicas_dead"] >= 1
+    assert s["requeued"] >= 1 and s["leases_revoked"] >= 1
+    assert s["duplicate_completions"] == 0
+    assert s["ledger"]["duplicates"] == 0
+    assert s["request_failures"] == 0
+    # the death was a REAL SIGKILL: the worker's wait status says so
+    assert any(d["reason"] == "exit" and d.get("code") == -9
+               for d in s["deaths"])
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    events = {r["event"] for r in recs if r["kind"] == "fleet_event"}
+    assert "replica_sigkill" in events and "replica_dead" in events
+    assert any(r["kind"] == "lease_requeue" for r in recs)
+    assert any(r["kind"] == "chaos" and r.get("fault") == "replica_sigkill"
+               for r in recs)
+
+
+def test_liveness_discriminates_slow_from_dead(tok, cfg, params, host_params,
+                                               tmp_path):
+    """slow_replica@R:ms against --replica_timeout: a stall shorter than
+    the timeout is a straggler and must NOT be declared dead; the SAME
+    fault outliving the timeout IS death — leases revoke, work requeues
+    onto the survivor, and parity holds either way."""
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        window_steps=8)
+    base = synthetic_request_stream(tok, 16, seed=3, max_new_tokens=MAX_NEW,
+                                    buckets=(8, 16))
+    want = _single_engine_tokens(params, cfg, tok, serve, base)
+    slow = FleetRouter(
+        host_params, cfg, serve,
+        FleetConfig(replicas=2, window_steps=4,
+                    fleet_dir=str(tmp_path / "slow"), replica_timeout=5.0,
+                    kill_spec="slow_replica@2:30"),
+        eos_id=int(tok.eos_token_id))
+    got = _tokens(slow.run(list(base), max_wall_s=300))
+    assert got.keys() == want.keys()
+    for rid in want:
+        np.testing.assert_array_equal(got[rid], want[rid], err_msg=f"rid {rid}")
+    s = slow.last_summary
+    assert s["replicas_dead"] == 0 and s["kills"] == 0
+    assert s["requeued"] == 0
+    # the dead case must not ride on wall-clock racing a warm (fast) run:
+    # rid 1 lands on replica 1 (least-loaded round-robin) and is PINNED
+    # stuck there, so the stalled replica provably holds a lease when its
+    # heartbeat age crosses the timeout; its deadline is the run's escape
+    # hatch once the request requeues (still stuck) onto the survivor
+    reqs = [dataclasses.replace(r, deadline_ms=800.0) if r.rid == 1 else r
+            for r in base]
+    dead = FleetRouter(
+        host_params, cfg, serve,
+        FleetConfig(replicas=2, window_steps=4,
+                    fleet_dir=str(tmp_path / "dead"), replica_timeout=0.15,
+                    kill_spec="slow_replica@2:60000,stuck_request@1"),
+        eos_id=int(tok.eos_token_id))
+    comps = dead.run(list(reqs), max_wall_s=300)
+    got = _tokens(comps)
+    assert got.keys() == want.keys()
+    for rid in want:
+        if rid != 1:
+            np.testing.assert_array_equal(got[rid], want[rid],
+                                          err_msg=f"rid {rid}")
+    assert {c.rid: c for c in comps}[1].reason == "deadline"
+    s = dead.last_summary
+    assert s["replicas_dead"] == 1 and s["requeued"] >= 1
+    assert s["leases_revoked"] >= 1
+    assert s["duplicate_completions"] == 0
+    assert s["per_replica"][1]["fate"] == "dead"
+    assert s["ledger"]["duplicates"] == 0
+    assert s["deadline_misses"] == 1
+
+
+def test_deadline_evicts_stuck_request(tok, cfg, params, host_params,
+                                       tmp_path):
+    """stuck_request@RID + deadline_ms: the pinned request is evicted at
+    its deadline as a reason="deadline" completion with partial output,
+    every OTHER request's tokens are untouched, and the miss lands in the
+    summary, the JSONL, and the --max_deadline_miss_pct gate."""
+    import importlib
+
+    from tpukit.obs import StepLogger
+
+    report = importlib.import_module("tools.report")
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        window_steps=8)
+    base = synthetic_request_stream(tok, 8, seed=3, max_new_tokens=MAX_NEW,
+                                    buckets=(8, 16))
+    want = _single_engine_tokens(params, cfg, tok, serve, base)
+    stuck_rid = base[2].rid
+    reqs = [dataclasses.replace(r, deadline_ms=600.0) if r.rid == stuck_rid
+            else r for r in base]
+    log = tmp_path / "deadline.jsonl"
+    logger = StepLogger(str(log))
+    router = FleetRouter(
+        host_params, cfg, serve,
+        FleetConfig(replicas=2, window_steps=4,
+                    kill_spec=f"stuck_request@{stuck_rid}"),
+        eos_id=int(tok.eos_token_id), logger=logger)
+    comps = router.run(list(reqs), max_wall_s=120)
+    logger.close()
+    got = _tokens(comps)
+    assert got.keys() == want.keys()
+    by_rid = {c.rid: c for c in comps}
+    assert by_rid[stuck_rid].reason == "deadline"
+    for rid in want:
+        if rid != stuck_rid:
+            np.testing.assert_array_equal(got[rid], want[rid],
+                                          err_msg=f"rid {rid}")
+    s = router.last_summary
+    assert s["deadline_misses"] == 1
+    assert s["duplicate_completions"] == 0
+
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    misses = [r for r in recs if r["kind"] == "deadline_miss"]
+    assert len(misses) == 1 and misses[0]["rid"] == stuck_rid
+    assert misses[0]["over_ms"] > 0
+    text = report.summarize(recs)
+    assert "fleet recovery" in text and "deadline miss" in text
+    # the gate: 1/8 = 12.5% — passes a 50% threshold, fails 5%
+    ok, msg = report.check_max_deadline_miss_pct(recs, 50.0)
+    assert ok, msg
+    ok, msg = report.check_max_deadline_miss_pct(recs, 5.0)
+    assert not ok and "FAIL" in msg
+    # no fleet summary at all -> fail, never a vacuous pass
+    ok, msg = report.check_max_deadline_miss_pct(
+        [r for r in recs if r["kind"] != "fleet_summary"], 50.0)
+    assert not ok and "no fleet_summary" in msg
+    # a pre-round-24 summary (no deadline_misses field) fails too
+    forged = [{k: v for k, v in s.items() if k != "deadline_misses"}]
+    ok, msg = report.check_max_deadline_miss_pct(forged, 50.0)
+    assert not ok and "deadline_misses" in msg
+
+
+def test_backpressure_sheds_lowest_priority(tok, cfg, params, host_params,
+                                            tmp_path):
+    """max_queue_depth backpressure: over-depth arrivals shed lowest
+    priority first, each as a NAMED request_rejected event and a terminal
+    backpressure ledger record; the admitted survivors stay token-exact."""
+    from tpukit.obs import StepLogger
+
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        window_steps=8)
+    base = synthetic_request_stream(tok, 8, seed=3, max_new_tokens=MAX_NEW,
+                                    buckets=(8, 16))
+    want = _single_engine_tokens(params, cfg, tok, serve, base)
+    keep = {base[0].rid, base[5].rid}
+    reqs = [dataclasses.replace(r, priority=1) if r.rid in keep else r
+            for r in base]
+    log = tmp_path / "shed.jsonl"
+    logger = StepLogger(str(log))
+    router = FleetRouter(
+        host_params, cfg, serve,
+        FleetConfig(replicas=2, window_steps=4, max_queue_depth=2,
+                    fleet_dir=str(tmp_path / "fleet")),
+        eos_id=int(tok.eos_token_id), logger=logger)
+    comps = router.run(list(reqs), max_wall_s=120)
+    logger.close()
+    got = _tokens(comps)
+    assert got.keys() == keep
+    for rid in keep:
+        np.testing.assert_array_equal(got[rid], want[rid], err_msg=f"rid {rid}")
+    s = router.last_summary
+    assert s["rejected"] == 6 and s["requests"] == 2
+    fails = router.ledger.failures()
+    assert set(fails) == {r.rid for r in base} - keep
+    assert all(f["reason"] == "backpressure" for f in fails.values())
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    rej = [r for r in recs if r["kind"] == "fleet_event"
+           and r["event"] == "request_rejected"]
+    assert len(rej) == 6
+    assert all(r["reason"] == "backpressure" for r in rej)
+
+
+def test_ledger_replay_resumes_at_frontier(tok, cfg, params, host_params,
+                                           tmp_path):
+    """A router crashing mid-stream (a ledger I/O fault outliving the
+    retry budget) leaves its completed frontier durable; a restarted
+    router over the SAME directory replays it and serves only the
+    remainder — the union is token-exact with zero duplicates."""
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        window_steps=8)
+    reqs = synthetic_request_stream(tok, 8, seed=3, max_new_tokens=MAX_NEW,
+                                    buckets=(8, 16))
+    want = _single_engine_tokens(params, cfg, tok, serve, reqs)
+    fdir = str(tmp_path / "fleet")
+    crashed = FleetRouter(
+        host_params, cfg, serve,
+        FleetConfig(replicas=2, window_steps=4, fleet_dir=fdir,
+                    # 9 consecutive failures of the 7th ledger operation:
+                    # past the default retry budget -> fatal, mid-stream
+                    kill_spec="ledger_io_fail@7:9"),
+        eos_id=int(tok.eos_token_id))
+    with pytest.raises(IOError, match="chaos: injected"):
+        crashed.run(list(reqs), max_wall_s=300)
+    durable = crashed.ledger.completions()
+    assert 1 <= len(durable) < 8
+    restarted = FleetRouter(
+        host_params, cfg, serve,
+        FleetConfig(replicas=2, window_steps=4, fleet_dir=fdir),
+        eos_id=int(tok.eos_token_id))
+    comps = restarted.run(list(reqs), max_wall_s=300)
+    # the restarted router served ONLY the not-yet-completed frontier...
+    assert {c.rid for c in comps} == set(want) - set(durable)
+    s = restarted.last_summary
+    assert s["ledger"]["replayed"] == len(durable)
+    assert s["ledger"]["completed"] == 8
+    assert s["ledger"]["duplicates"] == 0
+    # ...and the durable union is the full stream, token-exact
+    got = {rid: list(map(int, rec["ids"]))
+           for rid, rec in restarted.ledger.completions().items()}
+    assert got.keys() == want.keys()
+    for rid in want:
+        np.testing.assert_array_equal(got[rid], want[rid], err_msg=f"rid {rid}")
+
+
+def test_ledger_io_fault_absorbed_by_retry(tok, cfg, params, host_params,
+                                           tmp_path):
+    """ledger_io_fail within the retry budget is absorbed: the run
+    completes token-exact and the injected faults surface as
+    kind="chaos" records, not failures."""
+    from tpukit.obs import StepLogger
+
+    serve = ServeConfig(slots=2, buckets=(8, 16), max_new_tokens=MAX_NEW,
+                        window_steps=8)
+    reqs = synthetic_request_stream(tok, 8, seed=3, max_new_tokens=MAX_NEW,
+                                    buckets=(8, 16))
+    want = _single_engine_tokens(params, cfg, tok, serve, reqs)
+    log = tmp_path / "iofault.jsonl"
+    logger = StepLogger(str(log))
+    router = FleetRouter(
+        host_params, cfg, serve,
+        FleetConfig(replicas=2, window_steps=4,
+                    fleet_dir=str(tmp_path / "fleet"),
+                    kill_spec="ledger_io_fail@2:2"),
+        eos_id=int(tok.eos_token_id), logger=logger)
+    got = _tokens(router.run(list(reqs), max_wall_s=300))
+    logger.close()
+    assert got.keys() == want.keys()
+    for rid in want:
+        np.testing.assert_array_equal(got[rid], want[rid], err_msg=f"rid {rid}")
+    s = router.last_summary
+    assert s["duplicate_completions"] == 0 and s["ledger"]["duplicates"] == 0
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    chaos_recs = [r for r in recs if r["kind"] == "chaos"]
+    assert sum(1 for r in chaos_recs if r.get("fault") == "ledger_io") == 2
+
+
+def test_serving_chaos_grammar_one_path():
+    """ONE grammar: every fleet fault kind parses through
+    validate_fleet_spec (shared with --chaos_spec's parse_spec), malformed
+    entries fail by name, and the round-24 FleetConfig knobs validate."""
+    entries = chaos_lib.validate_fleet_spec(
+        "replica_kill@3,replica_sigkill@4:1,slow_replica@2:50,"
+        "stuck_request@7,ledger_io_fail@2:3")
+    assert [e["kind"] for e in entries] == [
+        "replica_kill", "replica_sigkill", "slow_replica",
+        "stuck_request", "ledger_io_fail"]
+    ch = chaos_lib.ServingChaos(
+        "replica_sigkill@4:1,slow_replica@2:50,stuck_request@7,"
+        "ledger_io_fail@2:3")
+    assert ch.sigkills == {4: [1]}
+    assert ch.stalls == {2: [0.05]}
+    assert ch.stuck == {7}
+    # FleetConfig.kill_spec rides the same path
+    FleetConfig(replicas=2, kill_spec="slow_replica@2:50")
+    with pytest.raises(chaos_lib.ChaosSpecError, match="stall"):
+        FleetConfig(replicas=2, kill_spec="slow_replica@2")
+    with pytest.raises(chaos_lib.ChaosSpecError, match="takes no param"):
+        chaos_lib.validate_fleet_spec("stuck_request@7:1")
+    with pytest.raises(chaos_lib.ChaosSpecError, match="1-based"):
+        chaos_lib.validate_fleet_spec("ledger_io_fail@0")
+    with pytest.raises(chaos_lib.ChaosSpecError, match="integer replica id"):
+        chaos_lib.validate_fleet_spec("replica_sigkill@5:-1")
+    # round-24 robustness knobs: named construction errors
+    with pytest.raises(ValueError, match="replica_timeout"):
+        FleetConfig(replicas=2, replica_timeout=-1.0)
+    with pytest.raises(ValueError, match="needs fleet_dir"):
+        FleetConfig(replicas=2, replica_timeout=1.0)
+    with pytest.raises(ValueError, match="request_retries"):
+        FleetConfig(replicas=2, request_retries=-1)
+    with pytest.raises(ValueError, match="max_queue_depth"):
+        FleetConfig(replicas=2, max_queue_depth=-1)
+
+
+def test_serving_chaos_io_fault_occurrence_semantics():
+    """A scheduled count of c fails the first c ATTEMPTS of that
+    occurrence (retries re-enter without advancing the index), then the
+    occurrence completes; foreign sites pass through untouched."""
+    ch = chaos_lib.ServingChaos("ledger_io_fail@2:2")
+    ch.io_fault("ledger")                       # occurrence 1 passes
+    with pytest.raises(IOError, match="occurrence 2"):
+        ch.io_fault("ledger")                   # occurrence 2, attempt 1
+    with pytest.raises(IOError, match="occurrence 2"):
+        ch.io_fault("ledger")                   # occurrence 2, attempt 2
+    ch.io_fault("ledger")                       # attempt 3 succeeds
+    ch.io_fault("ledger")                       # occurrence 3 passes
+    fired = ch.drain_fired()
+    assert len(fired) == 2
+    assert all(f["fault"] == "ledger_io" for f in fired)
+    ch2 = chaos_lib.ServingChaos("ledger_io_fail@1:1")
+    ch2.io_fault("checkpoint")                  # not this plan's site
 
 
 def test_fleet_decode_plan_is_standalone_plan():

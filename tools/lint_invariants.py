@@ -24,7 +24,12 @@ re-break in review because the broken form LOOKS idiomatic:
                      `_write_blob`, `_write_shard`, `_write_shard_digest`)
                      may be passed TO retry_io but never called directly —
                      a direct call silently opts that path out of the
-                     round-9 transient-fault budget.
+                     round-9 transient-fault budget. Round 24 puts the
+                     request-ledger helpers (`_write_rec`, `_read_rec`,
+                     tpukit/serve/ledger.py) under the same rule: fleet
+                     serving's durable records share the transient-fault
+                     budget, and the chaos harness's ledger_io_fail
+                     injections must always land inside a retry.
   sampling-spelling  No new `fold_in`-based sampling math outside
                      `sampling._sample_next`: flags
                      `jax.random.categorical` calls anywhere else. The
@@ -121,6 +126,13 @@ _HEAVY_ROOTS = frozenset({"jax", "jaxlib", "numpy", "np", "tpukit",
 _RAW_IO_HELPERS = frozenset({
     "_read_blob", "_write_blob", "_write_shard", "_write_shard_digest",
 })
+
+# The raw request-ledger I/O helpers (tpukit/serve/ledger.py, round 24)
+# under the same discipline: every call site outside their home file
+# wraps them in retry_io so fleet serving survives transient filesystem
+# errors — and so the chaos harness's ledger_io_fail injections always
+# land inside a retry budget.
+_LEDGER_IO_HELPERS = frozenset({"_write_rec", "_read_rec"})
 
 # The wire-collective primitives quant_comm.py owns (collective-spelling):
 # the async-start spellings of the grad/dispatch wire. lax.psum/ppermute
@@ -291,15 +303,17 @@ class _Visitor(ast.NodeVisitor):
                 f"carry a waiver naming why this is a rename, not a "
                 f"publish)",
             )
-        # retry-io: direct call of a raw checkpoint I/O helper
+        # retry-io: direct call of a raw checkpoint/ledger I/O helper
         if (
             isinstance(fn, ast.Name)
-            and fn.id in _RAW_IO_HELPERS
+            and fn.id in (_RAW_IO_HELPERS | _LEDGER_IO_HELPERS)
             and not self._in_function(fn.id)
         ):
+            what = ("checkpoint blob/manifest"
+                    if fn.id in _RAW_IO_HELPERS else "request-ledger")
             self._flag(
                 "retry-io", node,
-                f"direct call of {fn.id}() — checkpoint blob/manifest I/O "
+                f"direct call of {fn.id}() — {what} I/O "
                 f"must be wrapped: retry_io({fn.id}, ...) keeps it inside "
                 f"the transient-fault budget",
             )
@@ -377,6 +391,8 @@ def lint_file(path: Path, rel: str | None = None) -> list[Violation]:
         owners.update(("atomic_write_text", "atomic_write_bytes"))
     if norm.endswith("tpukit/checkpoint.py"):
         owners.update(_RAW_IO_HELPERS)  # a helper may recurse on itself
+    if norm.endswith("tpukit/serve/ledger.py"):
+        owners.update(_LEDGER_IO_HELPERS)  # the ledger defines its helpers
     if norm.endswith("tpukit/sampling.py"):
         owners.add("_sample_next")
     if norm.endswith("tpukit/ops/pallas_attention.py"):

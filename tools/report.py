@@ -582,6 +582,41 @@ def summarize(records: list[dict]) -> str:
               f"{r.get('requeued', 0)} request(s) re-queued, "
               f"{dups} duplicate completion(s)"
               + ("" if not dups else "  <- EXACTLY-ONCE VIOLATED"))
+        # round-24 fleet recovery: the crash-tolerance plane's accounting —
+        # liveness deaths, lease revocation/requeue, deadline misses,
+        # backpressure sheds, terminal failures, ledger replay, retried
+        # transient I/O. Rendered whenever any of it is nonzero (or a
+        # ledger ran), so a clean run stays one line shorter.
+        led = r.get("ledger")
+        recovery = [r.get("replicas_dead"), r.get("leases_revoked"),
+                    r.get("deadline_misses"), r.get("rejected"),
+                    r.get("request_failures"), r.get("retry_total"),
+                    r.get("respawns")]
+        if any(recovery) or isinstance(led, dict):
+            n_req = max(r.get("requests") or 0, 1)
+            miss = r.get("deadline_misses", 0) or 0
+            w(f"  fleet recovery: {r.get('replicas_dead', 0) or 0} liveness "
+              f"death(s), {r.get('leases_revoked', 0) or 0} lease(s) "
+              f"revoked, {r.get('requeued', 0)} requeued, "
+              f"{miss} deadline miss(es) "
+              f"({100.0 * miss / n_req:.1f}%), "
+              f"{r.get('rejected', 0) or 0} shed by backpressure, "
+              f"{r.get('request_failures', 0) or 0} terminal failure(s)"
+              + (f", {r.get('retry_total')} transient I/O retried"
+                 if r.get("retry_total") else "")
+              + (f", {r.get('respawns')} respawn(s)"
+                 if r.get("respawns") else ""))
+        if isinstance(led, dict):
+            w(f"  ledger: {led.get('completed', 0)} durable completion "
+              f"record(s), {led.get('replayed', 0)} replayed on restart, "
+              f"{led.get('duplicates', 0)} duplicate record(s)"
+              + ("" if not led.get("duplicates")
+                 else "  <- EXACTLY-ONCE VIOLATED"))
+        codes = r.get("worker_exit_codes")
+        if isinstance(codes, dict) and codes:
+            w("  worker exit codes: " + "  ".join(
+                f"r{k}={'SIGKILL' if v == -9 else v}"
+                for k, v in sorted(codes.items(), key=lambda kv: str(kv[0]))))
         if r.get("scale_ups") or r.get("scale_downs"):
             w(f"  autoscale: {r.get('scale_ups', 0)} up / "
               f"{r.get('scale_downs', 0)} down")
@@ -1085,6 +1120,35 @@ def check_min_fleet_tps(records: list[dict], threshold: float) -> tuple[bool, st
     )
 
 
+def check_max_deadline_miss_pct(records: list[dict],
+                                threshold: float) -> tuple[bool, str]:
+    """Deadline-miss CI gate (`--max_deadline_miss_pct`, round 24): the
+    last `kind="fleet_summary"` record's deadline_misses as a percentage
+    of served requests must be <= `threshold`. Returns (ok, message) — a
+    log without a fleet summary, or a summary missing the
+    deadline_misses field (a pre-round-24 log), FAILS: the gate can't
+    pass vacuously against a run that never accounted deadlines (the
+    `--min_accept_rate` discipline)."""
+    sums = _rows(records, "fleet_summary")
+    if not sums:
+        return False, ("--max_deadline_miss_pct: no fleet_summary record "
+                       "in the log (was the run --replicas'ed?)")
+    s = sums[-1]
+    miss = s.get("deadline_misses")
+    if miss is None:
+        return False, ("--max_deadline_miss_pct: fleet_summary carries no "
+                       "deadline_misses field (pre-round-24 log? rerun "
+                       "with the current recipe)")
+    n_req = s.get("requests") or 0
+    pct = 100.0 * miss / n_req if n_req else 0.0
+    ok = pct <= threshold
+    verdict = "OK" if ok else "FAIL"
+    return ok, (
+        f"--max_deadline_miss_pct {verdict}: {miss}/{n_req} requests "
+        f"missed their deadline ({pct:.2f}%; threshold {threshold:.2f}%)"
+    )
+
+
 def check_min_trace_complete(records: list[dict], threshold: float) -> tuple[bool, str]:
     """Trace-completeness CI gate (`--min_trace_complete`, round 20): the
     fraction of `kind="trace"` span trees satisfying the completeness
@@ -1402,6 +1466,11 @@ GATES: tuple = (
      "assert the fleet_summary tokens/s >= this with zero "
      "duplicate completions (exit 2 below it, or when the log has no "
      "fleet summary) — the fleet-serving regression gate for CI"),
+    ("max_deadline_miss_pct", "PERCENT", check_max_deadline_miss_pct,
+     "assert the fleet_summary's deadline_misses <= PERCENT of served "
+     "requests (exit 2 above it, or when the log has no fleet summary "
+     "or the summary predates deadline accounting) — the round-24 "
+     "request-deadline regression gate for CI"),
     ("min_trace_complete", "FRACTION", check_min_trace_complete,
      "assert the fraction of complete request span trees "
      "(kind=\"trace\" rows: closed AND phase walls summing to e2e "

@@ -44,14 +44,34 @@ is recovered by the backoff wrapper, c > fails loud):
     ckpt_read_fail@K[:c]   checkpoint read path (restore)
     loader_io_fail@K[:c]   DataLoader batch fetch
 
-fleet-scoped kinds (round 19, tpukit/serve/fleet.py — the serving
-router's failure model, indexed by fleet DISPATCH ROUND, not training
-step; legal only in `FleetConfig.kill_spec` / `--fleet_kill`, and
-rejected by the training ChaosEngine with a named error so a misplaced
-entry fails at startup):
-    replica_kill@R[:idx]   at dispatch round R, drop replica idx (default:
-                           the highest live id) — its in-flight requests
-                           re-queue onto the surviving replicas
+fleet-scoped kinds (rounds 19 + 24, tpukit/serve/fleet.py + ledger.py —
+the serving router's failure model, indexed by fleet DISPATCH ROUND
+(or supervisor poll round in `--fleet_procs` mode), not training step;
+legal only in `FleetConfig.kill_spec` / `--fleet_kill`, validated by
+`validate_fleet_spec`, consumed by `ServingChaos`, and rejected by the
+training ChaosEngine with a named error so a misplaced entry fails at
+startup):
+    replica_kill@R[:idx]    at dispatch round R, drop replica idx
+                            (default: the highest live id) — its
+                            in-flight requests re-queue onto the
+                            surviving replicas (simulated, in-process)
+    replica_sigkill@R[:idx] same targeting, but REAL process death:
+                            SIGKILL the replica worker process (only
+                            meaningful under `--fleet_procs`; the
+                            in-process router treats it as replica_kill
+                            and says so in the fired event)
+    slow_replica@R:ms       at round R the target's HEARTBEAT stalls for
+                            ms milliseconds without the replica dying —
+                            the straggler/dead discrimination case:
+                            ms < --replica_timeout must NOT kill it
+    stuck_request@N         request rid N never reaches EOS (its lane is
+                            pinned host-side past natural retirement) —
+                            exercises deadline_ms eviction; the device
+                            program is untouched
+    ledger_io_fail@K[:c]    the K-th ledger file operation fails c
+                            consecutive attempts (occurrence-indexed,
+                            same semantics as ckpt_io_fail; c <=
+                            --io_retries is absorbed by retry_io)
 
 Injection sites call the module-level hooks (`maybe_io_fault`), which are
 a single `is None` test when no harness is installed — chaos off costs
@@ -71,9 +91,16 @@ STEP_KINDS = (
     "nan_loss", "spike_loss", "sigterm", "sigint", "hang", "bitflip", "resize",
 )
 IO_KINDS = ("ckpt_io_fail", "ckpt_read_fail", "loader_io_fail")
-# fleet-scoped kinds (round 19): parsed by the shared grammar, consumed by
-# serve/fleet.FleetRouter, REJECTED by the training ChaosEngine below
-FLEET_KINDS = ("replica_kill",)
+# fleet-scoped kinds (rounds 19 + 24): parsed by the shared grammar,
+# consumed by ServingChaos (serve/fleet.FleetRouter + serve/ledger),
+# REJECTED by the training ChaosEngine below
+FLEET_KINDS = (
+    "replica_kill", "replica_sigkill", "slow_replica", "stuck_request",
+    "ledger_io_fail",
+)
+# the fleet kinds whose `@R` is a dispatch round and whose optional param
+# is a replica id (shared targeting grammar)
+_REPLICA_TARGET_KINDS = ("replica_kill", "replica_sigkill")
 # io-site label (as used by the checkpoint/loader call sites) per kind
 _IO_SITE = {
     "ckpt_io_fail": "ckpt_write",
@@ -137,14 +164,26 @@ def parse_spec(spec: str) -> list[dict]:
                     f"chaos spec entry {raw!r}: resize needs an integer "
                     f"target world size >= 1 (resize@N:M)"
                 )
-        if kind == "replica_kill" and entry["param"] is not None:
+        if kind in _REPLICA_TARGET_KINDS and entry["param"] is not None:
             p = entry["param"]
             if p != int(p) or int(p) < 0:
                 raise ChaosSpecError(
-                    f"chaos spec entry {raw!r}: replica_kill's optional "
+                    f"chaos spec entry {raw!r}: {kind}'s optional "
                     f"target must be an integer replica id >= 0"
                 )
-        if kind in IO_KINDS:
+        if kind == "slow_replica":
+            p = entry["param"]
+            if p is None or p <= 0:
+                raise ChaosSpecError(
+                    f"chaos spec entry {raw!r}: slow_replica needs a stall "
+                    f"duration in ms > 0 (slow_replica@R:ms)"
+                )
+        if kind == "stuck_request" and entry["param"] is not None:
+            raise ChaosSpecError(
+                f"chaos spec entry {raw!r}: stuck_request takes no param "
+                f"(stuck_request@RID pins request RID past EOS)"
+            )
+        if kind in IO_KINDS or kind == "ledger_io_fail":
             if entry["at"] < 1:
                 raise ChaosSpecError(
                     f"chaos spec entry {raw!r}: I/O occurrences are 1-based "
@@ -365,17 +404,120 @@ class ChaosEngine:
         return out
 
 
+def validate_fleet_spec(spec: str) -> list[dict]:
+    """Parse + validate a `FleetConfig.kill_spec` / `--fleet_kill` plan.
+
+    The ONE grammar/validation path for fleet fault plans (round 24 closed
+    the bespoke check fleet.py used to carry): entries go through the same
+    `parse_spec` as `--chaos_spec`, then any non-fleet kind is rejected
+    with a named error — the mirror image of ChaosEngine rejecting
+    fleet-scoped kinds."""
+    entries = parse_spec(spec)
+    for e in entries:
+        if e["kind"] not in FLEET_KINDS:
+            raise ChaosSpecError(
+                f"fleet kill spec {e['kind']}@{e['at']}: only fleet-scoped "
+                f"faults ({', '.join(FLEET_KINDS)}, e.g. replica_kill@R) "
+                f"are legal in FleetConfig.kill_spec / --fleet_kill — "
+                f"training faults go via --chaos_spec"
+            )
+    return entries
+
+
+class ServingChaos:
+    """One serving run's fleet fault plan (round 24) — the serving-side
+    twin of ChaosEngine, consumed by serve/fleet.FleetRouter (in-process)
+    and serve/ledger.ProcessFleet (real worker processes).
+
+    Same determinism contract: round-indexed faults fire exactly once at
+    their dispatch/poll round, occurrence-indexed ledger I/O faults fail
+    the scheduled attempt counts and never re-fire. The router installs
+    this via `install()` for the run's duration so the ledger's raw file
+    helpers can reach `io_fault(\"ledger\")` through the same module hook
+    the checkpoint sites use."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self._lock = threading.Lock()
+        self.fired: list[dict] = []
+        # round -> [target replica id or None (= highest live)]
+        self.kills: dict[int, list[int | None]] = {}
+        self.sigkills: dict[int, list[int | None]] = {}
+        # round -> [stall duration in seconds]
+        self.stalls: dict[int, list[float]] = {}
+        # request rids pinned past EOS (deadline eviction's quarry)
+        self.stuck: set[int] = set()
+        self._io_plan: dict[str, dict[int, int]] = {"ledger": {}}
+        self._io_seen: dict[str, int] = {"ledger": 0}
+        for e in validate_fleet_spec(spec):
+            kind, at, param = e["kind"], e["at"], e["param"]
+            if kind == "replica_kill":
+                self.kills.setdefault(at, []).append(
+                    int(param) if param is not None else None
+                )
+            elif kind == "replica_sigkill":
+                self.sigkills.setdefault(at, []).append(
+                    int(param) if param is not None else None
+                )
+            elif kind == "slow_replica":
+                self.stalls.setdefault(at, []).append(float(param) / 1e3)
+            elif kind == "stuck_request":
+                self.stuck.add(at)
+            elif kind == "ledger_io_fail":
+                count = int(param) if param is not None else 1
+                self._io_plan["ledger"][at] = count
+
+    def io_fault(self, site: str) -> None:
+        """Occurrence-indexed ledger I/O faults — identical semantics to
+        ChaosEngine.io_fault (a scheduled count of c fails the first c
+        ATTEMPTS of that occurrence; retries re-enter without advancing
+        the index). Sites other than \"ledger\" are not this plan's and
+        pass through untouched."""
+        with self._lock:
+            plan = self._io_plan.get(site)
+            if plan is None:
+                return
+            seen = self._io_seen[site] + 1
+            remaining = plan.get(seen)
+            if remaining is not None and remaining > 0:
+                plan[seen] = remaining - 1
+                self.fired.append(
+                    {"fault": f"{site}_io", "occurrence": seen,
+                     "remaining": remaining - 1}
+                )
+                raise IOError(
+                    f"chaos: injected transient {site} failure "
+                    f"(occurrence {seen})"
+                )
+            self._io_seen[site] = seen
+
+    def record(self, event: dict) -> None:
+        """Router/supervisor-side fault firings (kills, stalls) land in the
+        same audit trail as the I/O faults."""
+        with self._lock:
+            self.fired.append(dict(event))
+
+    def drain_fired(self) -> list[dict]:
+        with self._lock:
+            out, self.fired = self.fired, []
+        return out
+
+
 # ---------------------------------------------------------------------------
-# Module-level injection hooks. The I/O sites (checkpoint.py, loader.py)
-# call `maybe_io_fault(site)` unconditionally — a no-op unless a harness
-# is installed (one None check). fit() installs the engine for the run's
-# duration and uninstalls it on exit, so chaos never leaks across fits.
+# Module-level injection hooks. The I/O sites (checkpoint.py, loader.py,
+# serve/ledger.py) call `maybe_io_fault(site)` unconditionally — a no-op
+# unless a harness is installed (one None check). fit() installs the
+# training engine for the run's duration; the fleet router installs its
+# ServingChaos the same way; both uninstall on exit, so chaos never leaks
+# across runs.
 # ---------------------------------------------------------------------------
 
-_ENGINE: ChaosEngine | None = None
+_ENGINE: ChaosEngine | ServingChaos | None = None
 
 
-def install(engine: ChaosEngine | None) -> ChaosEngine | None:
+def install(
+    engine: ChaosEngine | ServingChaos | None,
+) -> ChaosEngine | ServingChaos | None:
     """Install (or clear, with None) the process-wide engine; returns the
     previous one so callers can restore it."""
     global _ENGINE
@@ -383,7 +525,7 @@ def install(engine: ChaosEngine | None) -> ChaosEngine | None:
     return prev
 
 
-def installed() -> ChaosEngine | None:
+def installed() -> ChaosEngine | ServingChaos | None:
     return _ENGINE
 
 

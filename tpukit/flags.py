@@ -494,8 +494,48 @@ def add_fleet_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="prefill worker pool pages (0 = the "
                         "--num_pages default)")
     parser.add_argument("--fleet_kill", type=str, default="",
-                        help="deterministic replica failure: "
-                        "replica_kill@R[:idx] chaos grammar — at dispatch "
-                        "round R drop that replica; its in-flight requests "
-                        "re-queue onto survivors")
+                        help="deterministic serving chaos (one grammar "
+                        "with --chaos_spec): replica_kill@R[:idx], "
+                        "replica_sigkill@R[:idx] (real SIGKILL under "
+                        "--fleet_procs), slow_replica@R:ms (heartbeat "
+                        "stall), stuck_request@N (lane never finishes — "
+                        "pair with --deadline_ms), ledger_io_fail@k:c "
+                        "(IOError on ledger I/O occurrence k, c times)")
+    parser.add_argument("--fleet_dir", type=str, default="",
+                        help="durable fleet state directory: the request "
+                        "ledger (write-ahead leases, exactly-once "
+                        "completion records, stream replay on restart) "
+                        "plus replica heartbeat files live here")
+    parser.add_argument("--replica_timeout", type=float, default=0.0,
+                        help="heartbeat liveness: declare a replica dead "
+                        "when its beat file is older than this many "
+                        "seconds — leases revoke, in-flight requests "
+                        "requeue on survivors (0 disables; requires "
+                        "--fleet_dir)")
+    parser.add_argument("--request_retries", type=int, default=3,
+                        help="per-request re-assignment budget after "
+                        "replica deaths; exhaustion is a terminal NAMED "
+                        "failure (reason=retry_budget), never a silent "
+                        "kill/requeue loop")
+    parser.add_argument("--max_queue_depth", type=int, default=0,
+                        help="queue-depth backpressure: shed arrived "
+                        "requests beyond this depth, lowest priority "
+                        "first, as named request_rejected events "
+                        "(0 = unbounded queue)")
+    parser.add_argument("--deadline_ms", type=float, default=0.0,
+                        help="per-request completion deadline applied to "
+                        "the synthetic stream: a lane still decoding past "
+                        "arrival+deadline is EVICTED with its partial "
+                        "tokens (reason=\"deadline\", kind=deadline_miss "
+                        "record; 0 = no deadlines)")
+    parser.add_argument("--fleet_procs", action="store_true",
+                        help="process fleet: run each replica as a real "
+                        "worker PROCESS driven through the ledger "
+                        "(requires --fleet_dir); replica_sigkill chaos "
+                        "delivers a real SIGKILL and liveness comes from "
+                        "process exit + heartbeat age")
+    parser.add_argument("--fleet_worker", type=int, default=-1,
+                        help="INTERNAL: run as ledger worker replica N "
+                        "(set by the --fleet_procs supervisor when "
+                        "re-execing itself; not for direct use)")
     return parser

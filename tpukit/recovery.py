@@ -97,6 +97,46 @@ def _atomic_write_json(path: Path, obj: dict) -> None:
     ckpt_lib._atomic_write_text(path, json.dumps(obj))
 
 
+# ---------------------------------------------------------------------------
+# Heartbeat-file discipline (shared reader/writer, round 24)
+#
+# One atomic JSON file per publisher in a shared directory is how every
+# liveness/coordination plane in tpukit talks across processes: training
+# heartbeats (obs/heartbeat.py), the rollback decision records above, and
+# the serving fleet's replica heartbeats (serve/fleet.py in-process,
+# serve/ledger.py real worker processes). These two helpers are the shared
+# spelling so the fleet's liveness plane follows the exact discipline the
+# training watchdog established instead of growing a third reader.
+# ---------------------------------------------------------------------------
+
+
+def publish_heartbeat(directory: str | Path, name: str, record: dict) -> None:
+    """Atomically publish one heartbeat record as `<directory>/<name>.json`
+    — the per-publisher file a liveness reader polls. Callers stamp their
+    own clock into the record (`t`): wall time for cross-process planes,
+    the run clock for in-process ones."""
+    _atomic_write_json(Path(directory) / f"{name}.json", record)
+
+
+def read_heartbeat_dir(directory: str | Path, prefix: str = "") -> dict[str, dict]:
+    """Read every heartbeat record in `directory` (optionally filtered by
+    filename prefix) as {stem: record}. Torn writes can't happen (atomic
+    publish) but foreign/partial files can — unparseable or vanished files
+    are skipped, not fatal, exactly like obs/heartbeat.Heartbeat.read_all."""
+    out: dict[str, dict] = {}
+    d = Path(directory)
+    if not d.is_dir():
+        return out
+    for path in sorted(d.glob(f"{prefix}*.json")):
+        try:
+            rec = json.loads(path.read_text())
+        except (ValueError, OSError):
+            continue
+        if isinstance(rec, dict):
+            out[path.stem] = rec
+    return out
+
+
 class TrainingAborted(RuntimeError):
     """Base of every deliberate abnormal training exit; `exit_code` is the
     process exit status the recipe entry point maps it to."""

@@ -110,6 +110,19 @@ def set_observer(fn: Callable[[dict], None] | None) -> None:
         _observer = fn
 
 
+def backoff_delay(attempt: int, policy: RetryPolicy | None = None) -> float:
+    """One jittered-exponential delay for retry `attempt` (1-based),
+    drawn from the process jitter stream under the given (or process
+    default) policy — the spelling non-I/O retry loops share. Round 24's
+    fleet router uses it to space request re-admissions after a replica
+    death: requeues are retries of DISPATCH, not of an I/O call, so they
+    can't ride retry_io, but they must not hammer the survivors in
+    lockstep either."""
+    with _lock:
+        pol = policy if policy is not None else _default_policy
+        return pol.delay(attempt, _rng)
+
+
 def retry_io(
     fn: Callable[..., Any],
     *args,

@@ -11,7 +11,11 @@ model proposers (round 17, ROADMAP #3) — in `spec.py`; fleet serving —
 a request router over N replica engines on disjoint device subsets,
 disaggregated prefill via paged-KV handoff, occupancy autoscale,
 chaos kill with exactly-once requeue (round 19, ROADMAP #1) — in
-`fleet.py`. Recipe: `main-serve.py`.
+`fleet.py`; the crash-tolerance plane — durable request ledger
+(write-ahead leases, exactly-once completion records, replay), the
+process-fleet supervisor with real-SIGKILL chaos and heartbeat
+liveness, and the ledger-driven worker loop (round 24) — in
+`ledger.py`. Recipe: `main-serve.py`.
 """
 
 from tpukit.serve import paged, spec  # noqa: F401
@@ -34,4 +38,9 @@ from tpukit.serve.fleet import (  # noqa: F401
     FleetConfig,
     FleetRouter,
     pick_serve_grid,
+)
+from tpukit.serve.ledger import (  # noqa: F401
+    ProcessFleet,
+    RequestLedger,
+    serve_from_ledger,
 )

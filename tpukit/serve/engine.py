@@ -65,7 +65,12 @@ class Request:
     batch, spaced for an arrival process. `trace` is the request's trace
     id (round 20, tpukit/obs/trace.py); -1 defaults it to the rid. A
     requeued-after-kill attempt reuses the SAME Request, so both
-    attempts share one trace id by construction."""
+    attempts share one trace id by construction. `deadline_ms` (round 24)
+    is an end-to-end latency bound measured from `arrival_s`: 0 disables
+    it, >0 makes the engine EVICT the request once exceeded (reason
+    \"deadline\", partial output kept). `priority` orders backpressure
+    shedding in the fleet router — lower sheds first; it never reorders
+    admission (FIFO within the arrived set is the latency contract)."""
 
     rid: int
     ids: tuple[int, ...]
@@ -73,6 +78,8 @@ class Request:
     seed: int = 0
     arrival_s: float = 0.0
     trace: int = -1
+    deadline_ms: float = 0.0
+    priority: int = 0
 
 
 def trace_id(req: Request) -> int:
@@ -93,7 +100,7 @@ class Completion:
     ids: np.ndarray
     prompt_len: int
     generated: int
-    reason: str  # "eos" | "length"
+    reason: str  # "eos" | "length" | "deadline"
     arrival_s: float
     admit_s: float
     done_s: float
@@ -593,7 +600,12 @@ class ServeEngine:
         self.steps = 0
         self.admitted = 0
         self.max_live = 0
-        self.evicted = {"eos": 0, "length": 0}
+        self.evicted = {"eos": 0, "length": 0, "deadline": 0}
+        # rids pinned past natural retirement (stuck_request@RID chaos,
+        # round 24): _sync_evict refuses to retire them so the lane holds
+        # its slot until deadline_ms eviction reclaims it — pure host-side
+        # control plane, the compiled decode step is untouched
+        self.stuck_rids: set[int] = set()
         self._gen_total = 0
         self.last_summary: dict | None = None
         # per-window deltas
@@ -1019,10 +1031,13 @@ class ServeEngine:
             tr.emit("quantum", -1, t0=q["t0"], t1=q["t1"], s0=s0,
                     s1=tr.now(), steps=q["steps"], lanes=q["lanes"],
                     replica=self.replica)
-        # prefilling paged lanes are act=False by design, not finished
+        # prefilling paged lanes are act=False by design, not finished;
+        # stuck_request-pinned lanes (chaos, round 24) are REFUSED
+        # retirement — they hold their slot until deadline eviction
         finished = [
             s for s, lane in self._lanes.items()
             if lane.phase == "decode" and not act[s]
+            and lane.req.rid not in self.stuck_rids
         ]
         gen_live = sum(
             int(cur[s]) - lane.prompt_len
@@ -1079,6 +1094,71 @@ class ServeEngine:
                     self._bt_dirty = True
                 self._free.append(s)
         self._gen_total = sum(c.generated for c in self.completions) + gen_live
+
+    def _evict_deadlines(self, now: float) -> None:
+        """Retire decode-resident lanes whose end-to-end deadline_ms has
+        expired (round 24): the partial output becomes a Completion with
+        reason=\"deadline\" plus a `kind=\"deadline_miss\"` JSONL record,
+        and the paged engine parks the lane's pages cheaply (release →
+        registered lead pages retire into the prefix LRU, private pages
+        free, block-table row zeroed — the same write-safety spelling as
+        natural retirement). Runs AFTER _sync_evict, so the quantum is
+        already synced and the extra cursor/buffer fetch happens only on
+        the rare eviction path. Prefill-phase lanes wait for their decode
+        transition (one chunk of grace) so an in-flight chunk never
+        targets released pages."""
+        over = [
+            (s, lane) for s, lane in self._lanes.items()
+            if lane.phase == "decode" and lane.req.deadline_ms > 0
+            and (now - lane.req.arrival_s) * 1e3 > lane.req.deadline_ms
+        ]
+        if not over:
+            return
+        cur, host_buf = map(
+            np.asarray, jax.device_get((self.cursors, self.buf))
+        )
+        tr = self.tracer
+        fin_t = tr.now() if tr is not None else 0.0
+        for s, lane in over:
+            self._lanes.pop(s)
+            length = int(cur[s])
+            generated = max(length - lane.prompt_len, 0)
+            ids = host_buf[s, :length].copy()
+            if self.serve.paged:
+                ids[: lane.prompt_len] = lane.req.ids
+            self.evicted["deadline"] += 1
+            over_ms = (now - lane.req.arrival_s) * 1e3 - lane.req.deadline_ms
+            self.completions.append(Completion(
+                rid=lane.req.rid, ids=ids,
+                prompt_len=lane.prompt_len, generated=generated,
+                reason="deadline", arrival_s=lane.req.arrival_s,
+                admit_s=lane.admit_s, done_s=now,
+                pages=len(lane.pages), prefix_pages=lane.shared,
+                active_s=lane.active_s or lane.admit_s,
+            ))
+            if self.logger is not None:
+                rec = dict(
+                    kind="deadline_miss", rid=lane.req.rid,
+                    deadline_ms=lane.req.deadline_ms,
+                    over_ms=round(over_ms, 3), generated=generated,
+                )
+                if self.replica is not None:
+                    rec["replica"] = self.replica
+                self.logger.log(**rec)
+            if self.metrics is not None:
+                self.metrics.inc("serve_deadline_miss")
+            if tr is not None:
+                tr.emit("finish", trace_id(lane.req), rid=lane.req.rid,
+                        t=fin_t, reason="deadline", generated=generated,
+                        replica=self.replica)
+            if self.serve.paged:
+                self.allocator.release(lane.pages)
+                self._bt[s] = 0
+                self._bt_dirty = True
+            self._free.append(s)
+        # _gen_total is untouched: the evicted tokens were already counted
+        # through the last sync's gen_live term, and the next _sync_evict
+        # recomputes from completions + live lanes
 
     # ---- telemetry -------------------------------------------------------
 
@@ -1263,6 +1343,7 @@ class ServeEngine:
             ) if self.steps else 0.0,
             admitted=self.admitted, evicted_eos=self.evicted["eos"],
             evicted_length=self.evicted["length"],
+            evicted_deadline=self.evicted["deadline"],
             p50_e2e_s=_pct([c.e2e_s for c in comps], 50),
             p99_e2e_s=_pct([c.e2e_s for c in comps], 99),
             p50_token_s=_pct([c.per_token_s for c in comps], 50),
@@ -1405,8 +1486,10 @@ class ServeEngine:
 
     def sync(self, now: float) -> None:
         """The per-quantum host sync: fetch cursors/flags, retire finished
-        lanes, and emit a `kind="serve"` window when one is due."""
+        lanes, evict deadline-expired ones, and emit a `kind="serve"`
+        window when one is due."""
         self._sync_evict(now)
+        self._evict_deadlines(now)
         if self._win["steps"] >= self.serve.window_steps:
             self._emit_window()
 

@@ -68,12 +68,16 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
+from pathlib import Path
 
 import numpy as np
 
 from tpukit import chaos as chaos_lib
+from tpukit import recovery as recovery_lib
+from tpukit import retry as retry_lib
 from tpukit.obs import metrics as metrics_lib
 from tpukit.obs import trace as trace_lib
+from tpukit.serve import ledger as ledger_lib
 from tpukit.serve import paged as paged_lib
 from tpukit.serve.engine import (
     Completion,
@@ -180,10 +184,32 @@ class FleetConfig:
     disagg_prefill: bool = False
     prefill_slots: int = 0  # 0 = the ServeConfig's slot count
     prefill_pages: int = 0  # 0 = the ServeConfig's pool default
-    # Deterministic replica failure: chaos grammar, replica_kill@R[:idx]
-    # — at dispatch round R, drop replica idx (default: the highest live
-    # id) and re-queue its in-flight requests onto survivors.
+    # Deterministic replica failure: the fleet-scoped chaos grammar
+    # (chaos.validate_fleet_spec — ONE parse/validation path with
+    # --chaos_spec since round 24): replica_kill@R[:idx],
+    # replica_sigkill@R[:idx], slow_replica@R:ms, stuck_request@RID,
+    # ledger_io_fail@K[:c].
     kill_spec: str = ""
+    # Crash-consistency plane (round 24, serve/ledger.py). fleet_dir
+    # roots the durable request ledger (write-ahead leases, exactly-once
+    # completion records, replay on restart) and the replica heartbeat
+    # files; empty keeps the round-19 in-memory lifecycle.
+    fleet_dir: str = ""
+    # Liveness: a replica whose heartbeat is older than this (seconds)
+    # is declared dead — leases revoked, in-flight requests requeued
+    # onto survivors. 0 disables the check; > 0 requires fleet_dir (the
+    # liveness plane IS the heartbeat files).
+    replica_timeout: float = 0.0
+    # Requeue budget per request: a request survives at most this many
+    # REASSIGNMENTS after its first (jittered-backoff-spaced, the
+    # retry.backoff_delay spelling); exhaustion lands it as a named
+    # `request_failed` event, never an infinite kill->requeue loop.
+    request_retries: int = 3
+    # Backpressure: when more than this many ARRIVED requests are
+    # queued, the lowest-priority (then latest) admissions shed with a
+    # named `request_rejected` event instead of queueing unboundedly.
+    # 0 = unbounded (the round-19 behavior).
+    max_queue_depth: int = 0
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -224,14 +250,26 @@ class FleetConfig:
                 "prefill worker — set disagg_prefill=True to run one"
             )
         # the kill plan must parse at construction (chaos's fail-at-startup
-        # contract), and only the fleet-scoped kind is legal here
-        for e in chaos_lib.parse_spec(self.kill_spec):
-            if e["kind"] != "replica_kill":
-                raise chaos_lib.ChaosSpecError(
-                    f"FleetConfig.kill_spec only takes replica_kill@R[:idx] "
-                    f"entries, got {e['kind']!r} — training faults belong in "
-                    f"--chaos_spec"
-                )
+        # contract) — ONE grammar/validation path with --chaos_spec
+        # (round 24 retired the bespoke check this used to carry)
+        chaos_lib.validate_fleet_spec(self.kill_spec)
+        if self.replica_timeout < 0:
+            raise ValueError(
+                f"replica_timeout={self.replica_timeout} must be >= 0"
+            )
+        if self.replica_timeout > 0 and not self.fleet_dir:
+            raise ValueError(
+                "replica_timeout needs fleet_dir: liveness is declared "
+                "from the heartbeat FILES replicas publish there"
+            )
+        if self.request_retries < 0:
+            raise ValueError(
+                f"request_retries={self.request_retries} must be >= 0"
+            )
+        if self.max_queue_depth < 0:
+            raise ValueError(
+                f"max_queue_depth={self.max_queue_depth} must be >= 0"
+            )
 
     @property
     def max_count(self) -> int:
@@ -334,6 +372,32 @@ class FleetRouter:
         self.scale_downs = 0
         self.handoffs = 0
         self.replicas_peak = 0
+        # robustness plane (round 24)
+        self.rejected = 0
+        self.request_failures = 0
+        self.replicas_dead = 0
+        self.leases_revoked = 0
+        self._attempts: dict[int, int] = {}    # rid -> assignments so far
+        self._not_before: dict[int, float] = {}  # rid -> backoff gate
+        self._stalled_until: dict[int, float] = {}  # replica -> wall time
+        self._ledger_marks: dict[int, int] = {}  # id(engine) -> published
+        self._replayed = 0
+        self._last_beat_t = 0.0                # heartbeat publish throttle
+        self._last_live_t = 0.0                # liveness check throttle
+
+        # the serving chaos plan (ONE grammar with --chaos_spec): kills/
+        # sigkills/stalls are round-indexed, ledger I/O faults occurrence-
+        # indexed through the module hook the router installs during run()
+        self._chaos = chaos_lib.ServingChaos(fleet.kill_spec)
+
+        # durable request lifecycle + liveness plane (round 24)
+        self.ledger = (
+            ledger_lib.RequestLedger(fleet.fleet_dir)
+            if fleet.fleet_dir else None
+        )
+        self._hb_dir = (
+            Path(fleet.fleet_dir) / "heartbeats" if fleet.fleet_dir else None
+        )
         self._done: list[Completion] = []      # retired/killed replicas'
         self._gen_removed = 0                  # their generated tokens
         self._replica_stats: dict = {}         # id -> final per-replica row
@@ -359,12 +423,14 @@ class FleetRouter:
                 replica="prefill", tracer=self.tracer,
             )
 
-        # kill plan: dispatch round -> list of target ids (None = highest)
-        self._kill_plan: dict[int, list] = {}
-        for e in chaos_lib.parse_spec(fleet.kill_spec):
-            self._kill_plan.setdefault(e["at"], []).append(
-                None if e["param"] is None else int(e["param"])
-            )
+        # kill plan (round 19; via ServingChaos since round 24): dispatch
+        # round -> target ids (None = highest live). The in-process
+        # router fires replica_sigkill as replica_kill — there is no
+        # process to kill — and says so in the event; real SIGKILL lives
+        # in ledger.ProcessFleet (--fleet_procs).
+        self._kill_plan = self._chaos.kills
+        self._sigkill_plan = self._chaos.sigkills
+        self._stall_plan = self._chaos.stalls
 
     # ---- replica lifecycle ----------------------------------------------
 
@@ -398,6 +464,7 @@ class FleetRouter:
             recorder=self.recorder, replica=idx, tracer=self.tracer,
             metrics=self.metrics,
         )
+        eng.stuck_rids = self._chaos.stuck
         self._replicas[idx] = eng
         self._metrics_replicas.add(idx)
         self.replicas_peak = max(self.replicas_peak, len(self._replicas))
@@ -430,14 +497,22 @@ class FleetRouter:
         ordering + first-maximal `max`). Each engine's batch admits in ONE
         call (the round-14 bucket-grouped batched prefill); paged pool
         pressure returns leftovers, which go back to the queue head in
-        arrival order."""
+        arrival order. Round 24: a requeued request additionally waits
+        out its jittered backoff gate (`_ready_at` — FIFO is preserved,
+        the head simply isn't ready yet), and with a ledger every
+        assignment is WRITTEN AHEAD of the engine seeing the request —
+        a crash between lease and dispatch replays as a requeue, never a
+        lost request. A leftover's assignment is returned (attempt
+        un-counted); its stale lease is overwritten at the next assign,
+        and replay treats any open lease as in-flight anyway
+        (at-least-once assignment, exactly-once completion)."""
         targets = [self.prefill] if self.prefill is not None else self._live()
         if not targets:
             return
         total_free = sum(e.free_slots for e in targets)
         arrived: list[Request] = []
         while (pending and len(arrived) < total_free
-               and pending[0].arrival_s <= now):
+               and self._ready_at(pending[0]) <= now):
             arrived.append(pending.popleft())
         if not arrived:
             return
@@ -452,9 +527,56 @@ class FleetRouter:
                                  t=now, dst=best.replica, replica="router")
         leftovers: list[Request] = []
         for e in targets:
-            leftovers.extend(e.admit(assign[id(e)], now))
+            batch = assign[id(e)]
+            if not batch:
+                continue
+            for req in batch:
+                att = self._attempts.get(req.rid, 0) + 1
+                self._attempts[req.rid] = att
+                if self.ledger is not None:
+                    self.ledger.assign(req.rid, e.replica, att, now)
+            left = e.admit(batch, now)
+            for req in left:
+                self._attempts[req.rid] -= 1
+            leftovers.extend(left)
         for req in sorted(leftovers, key=lambda r: r.rid, reverse=True):
             pending.appendleft(req)
+
+    def _ready_at(self, req: Request) -> float:
+        """When a queued request may admit: its arrival, or its post-
+        requeue backoff gate, whichever is later."""
+        return max(req.arrival_s, self._not_before.get(req.rid, 0.0))
+
+    def _shed(self, pending: deque, now: float) -> None:
+        """Queue-depth backpressure: when more than `max_queue_depth`
+        ARRIVED requests are waiting, shed the excess — lowest priority
+        first, then latest arrival (highest rid) — each as a NAMED
+        `request_rejected` event (and a terminal ledger record, so a
+        replayed stream doesn't resurrect it). Shedding at admission
+        time, not arrival time, means a queue that drains fast enough
+        never rejects."""
+        depth = self.fleet.max_queue_depth
+        if not depth or len(pending) <= depth:
+            return
+        arrived = [r for r in pending if r.arrival_s <= now]
+        if len(arrived) <= depth:
+            return
+        shed = sorted(arrived, key=lambda r: (r.priority, -r.rid))
+        shed = shed[: len(arrived) - depth]
+        drop = {r.rid for r in shed}
+        kept = [r for r in pending if r.rid not in drop]
+        pending.clear()
+        pending.extend(kept)
+        for req in sorted(shed, key=lambda r: r.rid):
+            self.rejected += 1
+            if self.metrics is not None:
+                self.metrics.inc("fleet_rejected")
+            if self.ledger is not None:
+                self.ledger.record_failure(req.rid, "backpressure",
+                                           self._attempts.get(req.rid, 0))
+            self._event("request_rejected", rid=req.rid,
+                        priority=req.priority, reason="backpressure",
+                        queue_depth=len(arrived))
 
     # ---- disaggregated prefill handoff ----------------------------------
 
@@ -516,24 +638,113 @@ class FleetRouter:
     # ---- failure + autoscale --------------------------------------------
 
     def _maybe_kill(self, rounds: int, now: float) -> None:
-        for target in self._kill_plan.pop(rounds, ()):
-            live = sorted(i for i in self._replicas)
-            if len(live) <= 1:
+        for plan, extra in (
+            (self._kill_plan, {}),
+            # in-process: a sigkill entry degrades to the simulated kill
+            # (there is no process to kill) and SAYS so — real SIGKILL
+            # is ledger.ProcessFleet's job (--fleet_procs)
+            (self._sigkill_plan, {"signal": "SIGKILL", "simulated": True}),
+        ):
+            for target in plan.pop(rounds, ()):
+                live = sorted(i for i in self._replicas)
+                if len(live) <= 1:
+                    self._event("kill_skipped", round=rounds,
+                                reason="last live replica")
+                    continue
+                idx = target if target in self._replicas else live[-1]
+                self._kill(idx, rounds, now, **extra)
+
+    def _fire_stalls(self, rounds: int) -> None:
+        """slow_replica@R:ms — stall the target's HEARTBEAT for ms of
+        wall clock without touching the engine: the straggler case the
+        liveness check must NOT confuse with death (unless the stall
+        outlives replica_timeout, in which case declaring it dead is the
+        correct call and the requeue path owns the request)."""
+        for stall_s in self._stall_plan.pop(rounds, ()):
+            live = sorted(self._replicas)
+            if not live:
+                continue
+            idx = live[-1]
+            until = time.time() + stall_s
+            self._stalled_until[idx] = max(
+                self._stalled_until.get(idx, 0.0), until
+            )
+            self._chaos.record(dict(fault="slow_replica", round=rounds,
+                                    replica=idx, stall_s=stall_s))
+            self._event("replica_slow", replica=idx, round=rounds,
+                        stall_s=stall_s)
+
+    def _beat(self, rounds: int) -> None:
+        """Publish each live replica's heartbeat file (recovery.py's
+        one-atomic-file-per-publisher discipline, retry-wrapped like any
+        other fleet file I/O). A chaos-stalled replica skips its beat —
+        that IS the fault."""
+        if self._hb_dir is None:
+            return
+        wall = time.time()
+        # throttle: the loop spins far faster than liveness needs — one
+        # beat per ~10 ms keeps heartbeat age resolution well under any
+        # sane replica_timeout without an fsync storm
+        if wall - self._last_beat_t < 0.01:
+            return
+        self._last_beat_t = wall
+        for idx, eng in sorted(self._replicas.items()):
+            if self._stalled_until.get(idx, 0.0) > wall:
+                continue
+            retry_lib.retry_io(
+                recovery_lib.publish_heartbeat, self._hb_dir,
+                f"replica-{idx:05d}",
+                dict(replica=idx, t=wall, round=rounds,
+                     generated=eng.generated_tokens, lanes=eng.live_lanes),
+                label="heartbeat",
+            )
+
+    def _check_liveness(self, rounds: int, now: float) -> None:
+        """Declare heartbeat-silent replicas dead: beat age over
+        `replica_timeout` revokes the replica's leases and requeues its
+        in-flight requests onto survivors — the round-19 kill path,
+        driven by the liveness plane instead of a scripted round."""
+        f = self.fleet
+        if f.replica_timeout <= 0 or self._hb_dir is None:
+            return
+        wall = time.time()
+        # check at ~4x the timeout's resolution, not every loop spin
+        if wall - self._last_live_t < min(f.replica_timeout / 4.0, 0.01):
+            return
+        self._last_live_t = wall
+        beats = recovery_lib.read_heartbeat_dir(self._hb_dir, "replica-")
+        for idx in sorted(self._replicas):
+            rec = beats.get(f"replica-{idx:05d}")
+            if rec is None:
+                continue  # not yet published — born this round
+            age = wall - float(rec["t"])
+            if age <= f.replica_timeout:
+                continue
+            if len(self._replicas) <= 1:
                 self._event("kill_skipped", round=rounds,
                             reason="last live replica")
                 continue
-            idx = target if target in self._replicas else live[-1]
-            self._kill(idx, rounds, now)
+            self.replicas_dead += 1
+            if self.metrics is not None:
+                self.metrics.inc("fleet_replica_dead")
+            self._kill(idx, rounds, now, event="replica_dead",
+                       reason="heartbeat_timeout", age_s=round(age, 3))
 
-    def _kill(self, idx: int, rounds: int, now: float) -> None:
+    def _kill(self, idx: int, rounds: int, now: float,
+              event: str = "replica_kill", **extra) -> None:
         """Drop replica `idx` mid-flight — the chaos failure model: the
         engine (device state and all) is discarded, its COMPLETED requests
         keep their already-emitted tokens, and its in-flight requests
         re-queue at the queue head with prompt+seed reconstructed from
         the Request (exactly-once output per request: partial tokens were
-        never emitted as completions)."""
+        never emitted as completions). Round 24 rides liveness deaths
+        (`event="replica_dead"`) through the same path, adds the
+        `request_retries` budget with jittered-backoff re-admission, and
+        publishes the killed engine's completion records to the ledger
+        BEFORE the engine is discarded."""
         eng = self._replicas.pop(idx)
         self._draining.discard(idx)
+        self._ledger_collect(eng)
         victims = eng.requeue_live()
         self._done.extend(eng.completions)
         # fold the victim's FULL generated count (completed + in-flight
@@ -547,22 +758,57 @@ class FleetRouter:
         self._replica_stats[idx] = dict(
             completions=len(eng.completions),
             tokens=sum(c.generated for c in eng.completions),
-            occupancy=None, fate="killed",
+            occupancy=None, fate="killed" if event == "replica_kill"
+            else "dead",
         )
         self.kills += 1
-        self.requeued += len(victims)
-        for req in reversed(victims):
-            self._pending.appendleft(req)
+        self.leases_revoked += len(victims)
+        kept = self._requeue(victims, idx, now)
         if self.tracer is not None:
             # the requeue event links the killed attempt and the retry
             # under ONE trace id — the same Request object re-queues, so
             # the retry's admit/finish land on the same tree
-            for req in victims:
+            for req in kept:
                 self.tracer.emit("requeue", trace_id(req), rid=req.rid,
                                  t=now, from_replica=idx, replica="router")
-        self._event("replica_kill", replica=idx, round=rounds,
-                    requeued=len(victims),
-                    requeued_rids=[r.rid for r in victims])
+        self._event(event, replica=idx, round=rounds,
+                    requeued=len(kept),
+                    requeued_rids=[r.rid for r in kept], **extra)
+        if self.logger is not None and self.ledger is not None and kept:
+            # the durable lease-revocation record: a restarted router can
+            # see WHICH leases each death invalidated
+            self.logger.log(kind="lease_requeue", from_replica=idx,
+                            rids=[r.rid for r in kept],
+                            attempts={str(r.rid): self._attempts.get(r.rid, 1)
+                                      for r in kept})
+
+    def _requeue(self, victims: list[Request], idx: int,
+                 now: float) -> list[Request]:
+        """Requeue a dead replica's in-flight requests at the queue head,
+        each gated behind a jittered backoff (`retry.backoff_delay` — the
+        survivors must not absorb the whole blast in lockstep) and the
+        per-request `request_retries` budget: exhaustion is a terminal,
+        NAMED failure, not a silent kill->requeue loop."""
+        kept: list[Request] = []
+        for req in victims:
+            n = self._attempts.get(req.rid, 1)
+            if n > self.fleet.request_retries:
+                self.request_failures += 1
+                self._event("request_failed", rid=req.rid, attempts=n,
+                            reason="retry_budget")
+                if self.metrics is not None:
+                    self.metrics.inc("fleet_request_failed")
+                if self.ledger is not None:
+                    self.ledger.record_failure(req.rid, "retry_budget", n)
+                continue
+            self._not_before[req.rid] = now + retry_lib.backoff_delay(n)
+            kept.append(req)
+        self.requeued += len(kept)
+        if self.metrics is not None and kept:
+            self.metrics.inc("fleet_requeued", len(kept))
+        for req in reversed(kept):
+            self._pending.appendleft(req)
+        return kept
 
     def _autoscale(self, mean_occ: float, queue_depth: int) -> None:
         f = self.fleet
@@ -590,6 +836,7 @@ class FleetRouter:
     def _retire(self, idx: int, eng: ServeEngine, wall: float,
                 fate: str) -> None:
         comps = eng.finish(wall)
+        self._ledger_collect(eng)
         self._done.extend(comps)
         self._gen_removed += sum(c.generated for c in comps)
         s = eng.last_summary or {}
@@ -602,6 +849,23 @@ class FleetRouter:
         self._draining.discard(idx)
 
     # ---- telemetry -------------------------------------------------------
+
+    def _ledger_collect(self, eng: ServeEngine) -> None:
+        """Publish an engine's NEW completions to the durable ledger —
+        called after every sync round and before any engine is discarded
+        (kill, liveness death, retire), so a crash never loses a finished
+        request. The per-engine mark makes this incremental; the ledger's
+        check-then-publish makes it exactly-once even when a killed
+        replica's work re-completes on a survivor."""
+        if self.ledger is None:
+            return
+        key = id(eng)
+        mark = self._ledger_marks.get(key, 0)
+        comps = eng.completions
+        for c in comps[mark:]:
+            self.ledger.complete(c, replica=eng.replica,
+                                 attempt=self._attempts.get(c.rid, 1))
+        self._ledger_marks[key] = len(comps)
 
     def _fleet_gen(self) -> int:
         return self._gen_removed + sum(
@@ -690,19 +954,25 @@ class FleetRouter:
     def _publish_metrics(self) -> None:
         """Per-replica snapshot files split from the shared registry by
         label (heartbeat-file discipline: one atomic file per publisher)
-        plus the router's process-0 merge beside them."""
+        plus the router's process-0 merge beside them. Every touch of the
+        shared filesystem rides `retry_io` (round 24) — a transient NFS
+        error in a metrics publish must not kill a serving fleet, and
+        each failed attempt surfaces as a `kind="retry"` record."""
         wall = time.time()
         count = self.fleet.max_count
         for idx in sorted(self._metrics_replicas):
-            metrics_lib.publish_snapshot(
-                self.metrics_dir, idx,
+            retry_lib.retry_io(
+                metrics_lib.publish_snapshot, self.metrics_dir, idx,
                 self.metrics.filter(replica=idx),
                 process_count=count, time_s=wall,
+                label="metrics_snapshot",
             )
-        merged, meta = metrics_lib.merge_snapshot_dir(
-            self.metrics_dir, process_count=count
+        merged, meta = retry_lib.retry_io(
+            metrics_lib.merge_snapshot_dir, self.metrics_dir,
+            process_count=count, label="metrics_merge",
         )
-        metrics_lib.write_merged(self.metrics_dir, merged, meta=meta)
+        retry_lib.retry_io(metrics_lib.write_merged, self.metrics_dir,
+                           merged, meta=meta, label="metrics_merge")
 
     def summary(self, wall_s: float) -> dict:
         comps = self._done
@@ -726,6 +996,11 @@ class FleetRouter:
             replicas_peak=self.replicas_peak,
             scale_ups=self.scale_ups, scale_downs=self.scale_downs,
             kills=self.kills, requeued=self.requeued,
+            rejected=self.rejected,
+            request_failures=self.request_failures,
+            replicas_dead=self.replicas_dead,
+            leases_revoked=self.leases_revoked,
+            deadline_misses=sum(1 for c in comps if c.reason == "deadline"),
             # the exactly-once invariant, as data: a rid appearing twice
             # means a killed replica's partial work double-emitted
             duplicate_completions=len(rids) - len(set(rids)),
@@ -763,6 +1038,12 @@ class FleetRouter:
             rec["slo_overall_compliance"] = (
                 self.slo_accountant.overall_compliance()
             )
+        if self.ledger is not None:
+            rec["ledger"] = dict(
+                completed=len(self.ledger.completions()),
+                replayed=self._replayed,
+                duplicates=self.ledger.duplicates(),
+            )
         return rec
 
     # ---- the loop --------------------------------------------------------
@@ -770,16 +1051,38 @@ class FleetRouter:
     def run(self, requests, max_wall_s: float | None = None) -> list[Completion]:
         """Serve `requests` across the fleet to completion; returns ALL
         completions in finish order. The loop per iteration: fire any
-        scheduled kill, admit arrived requests least-loaded, advance
+        scheduled kill/stall, check heartbeat liveness, publish beats,
+        shed over-depth queue, admit ready requests least-loaded, advance
         prefill (worker chunks + handoffs, or per-replica chunks),
         DISPATCH every replica's decode quantum (async — disjoint subsets
-        overlap), then sync each and retire finished lanes. Fleet windows
-        and the autoscale check run every `FleetConfig.window_steps`
-        dispatch rounds."""
+        overlap), then sync each, publish fresh completions to the ledger,
+        and retire finished lanes. Fleet windows and the autoscale check
+        run every `FleetConfig.window_steps` dispatch rounds. With a
+        `fleet_dir`, the request stream is durable: a restarted router
+        passed the same stream replays the ledger and serves only the
+        not-yet-completed frontier."""
+        if self.ledger is not None:
+            requests, done_recs = self.ledger.open_stream(requests)
+            self._replayed = len(done_recs)
+            if self._replayed:
+                self._event("ledger_replay", completed=self._replayed,
+                            remaining=len(requests))
         self._pending = deque(
             sorted(requests, key=lambda r: (r.arrival_s, r.rid))
         )
         pending = self._pending
+        # the serving chaos engine is process-global for the run so the
+        # ledger's raw I/O helpers reach it through the same
+        # chaos.maybe_io_fault hook the checkpoint sites use
+        prev_chaos = chaos_lib.installed()
+        chaos_lib.install(self._chaos)
+        try:
+            return self._run_loop(pending, max_wall_s)
+        finally:
+            chaos_lib.install(prev_chaos)
+
+    def _run_loop(self, pending: deque,
+                  max_wall_s: float | None) -> list[Completion]:
         # reset every engine's span epoch to the FLEET run start so the
         # construction->run gap lands nowhere (the engine.run discipline)
         for eng in self._replicas.values():
@@ -804,6 +1107,10 @@ class FleetRouter:
                     f"live lanes"
                 )
             self._maybe_kill(rounds, now)
+            self._fire_stalls(rounds)
+            self._check_liveness(rounds, now)
+            self._beat(rounds)
+            self._shed(pending, now)
             self._admit(pending, now)
             if self.prefill is not None:
                 self.prefill.poll_prefill(time.perf_counter() - t0)
@@ -818,7 +1125,7 @@ class FleetRouter:
                           if e.dispatch_decode()]
             if not dispatched:
                 if not self._any_lanes() and pending:
-                    wait = pending[0].arrival_s - now
+                    wait = self._ready_at(pending[0]) - now
                     if wait > 0:
                         time.sleep(min(wait, 0.05))
                 continue
@@ -832,6 +1139,7 @@ class FleetRouter:
             snow = time.perf_counter() - t0
             for eng in dispatched:
                 eng.sync(snow)
+                self._ledger_collect(eng)
             self._win["rounds"] += 1
             self._win["occ"] += decoding / max(slots, 1)
             if self._win["rounds"] >= self.fleet.window_steps:
@@ -843,6 +1151,9 @@ class FleetRouter:
             self._emit_window(wall, 0)
         for idx, eng in sorted(self._replicas.items()):
             self._retire(idx, eng, wall, fate="final")
+        if self.logger is not None:
+            for ev in self._chaos.drain_fired():
+                self.logger.log(kind="chaos", **ev)
         rec = self.last_summary = self.summary(wall)
         if self.logger is not None:
             self.logger.log(**rec)

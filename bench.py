@@ -1612,6 +1612,180 @@ def bench_comm_overlap(cfg, n_dev, num_experts=8, steps=8):
     return rows
 
 
+def bench_pipe_interleave(n_dev, steps=3, micro=8):
+    """Interleaved-1F1B ladder (round 25, --virtual_stages): the flat
+    1F1B tick machine vs V=2 and V=4 virtual chunks per device at EQUAL
+    micro-batch count. Two kinds of numbers, kept apart on purpose:
+
+      - `bubble_table` + per-rung `bubble_frac`: weighted idle-phase
+        accounting straight off the tick table (pipeline_schedule.py,
+        backward at 2x forward cost; the V=1 row is the closed form
+        (2S-2)/(M+2S-2)). Deterministic, backend-free — the numbers
+        tools/report.py's --min_bubble_gain gate pins, because on CPU
+        virtual devices wall-clock is loopback noise (the
+        --min_overlap_frac discipline).
+      - per-rung step time / tokens/s/chip and `wall_ratio_vs_flat` vs
+        `predicted_ratio_vs_flat` (schedule cost in forward-units, a
+        chunk being 1/V of a flat stage pass): the wall cross-check a
+        real multi-chip run compares. On CPU the unrolled machine's
+        per-tick dispatch overhead dilutes the predicted win.
+
+    Rungs that fail land as {"virtual_stages": V, "error": ...} so a
+    machine that stops compiling cannot hide behind the pure-math table
+    (the gate fails on errored rungs)."""
+    import jax.numpy as jnp
+
+    from tools.bench_ladder import make_batch, setup_step, time_windows
+    from tpukit.mesh import create_mesh
+    from tpukit.model import GPTConfig
+    from tpukit.pipeline import Pipeline1F1B
+    from tpukit.pipeline_schedule import (
+        bubble_table, cached_schedule, flat_1f1b_bubble,
+    )
+
+    stages = 4 if n_dev >= 4 else 2
+    layers = 4 * stages  # V=4 needs S*V chunks <= layers
+    seq = 128
+    cfg_p = GPTConfig(
+        dim=128, head_dim=32, heads=4, num_layers=layers, vocab_size=8192,
+        max_position_embeddings=seq, compute_dtype=jnp.bfloat16,
+    )
+    batch = 2 * micro  # two rows per micro-batch
+    record = {
+        "stages": stages,
+        "microbatches": micro,
+        "layers": layers,
+        # the measured-bubble grid the gate checks: V x M, tick-table
+        # accounting (V=1 rows are the closed form)
+        "bubble_table": bubble_table(stages),
+        "rungs": [],
+        "caveat": (
+            "CPU loopback: per-tick dispatch overhead dilutes the "
+            "schedule win; bubble_frac/predicted_ratio are the "
+            "backend-transferable numbers"
+        ),
+    }
+    # schedule cost in forward-units: flat runs fwd+bwd EVERY tick (its
+    # idle ticks still compute garbage), interleaved only on live phases
+    # at 1/V the per-tick work
+    flat_cost = 3.0 * (micro + 2 * stages - 2)
+    flat_step = None
+    for v in (1, 2, 4):
+        try:
+            if v == 1:
+                bubble = flat_1f1b_bubble(stages, micro)
+                cost = flat_cost
+            else:
+                st = cached_schedule(stages, v, micro).stats
+                bubble = st["bubble_frac"]
+                cost = (st["fwd_phase_ticks"]
+                        + 2.0 * st["bwd_phase_ticks"]) / v
+            strat = Pipeline1F1B(
+                create_mesh({"stage": stages}), num_microbatches=micro
+            )
+            c = cfg_p.replace(virtual_stages=v)
+            strat.validate_config(c)
+            b, t = make_batch(np.random.RandomState(5), c.vocab_size,
+                              batch, seq)
+            step, state, _, _ = setup_step(c, strat)
+            times, state, loss = time_windows(
+                step, state, b, t, steps=steps, windows=3, warmup=2
+            )
+            del state
+            step_time = min(times) / steps
+            row = {
+                "virtual_stages": v,
+                "bubble_frac": round(bubble, 4),
+                "sched_cost_units": round(cost, 2),
+                "predicted_ratio_vs_flat": round(cost / flat_cost, 4),
+                "step_time_s": round(step_time, 6),
+                "tokens_per_sec_per_chip": round(
+                    batch * seq / step_time / stages, 1
+                ),
+                "final_loss": round(loss, 6),
+            }
+            if v == 1:
+                flat_step = step_time
+            else:
+                row["wall_ratio_vs_flat"] = (
+                    round(step_time / flat_step, 4) if flat_step else None
+                )
+            record["rungs"].append(row)
+        except Exception as exc:
+            record["rungs"].append(
+                {"virtual_stages": v, "error": repr(exc)}
+            )
+            print(f"pipe interleave rung V={v} failed: {exc!r}",
+                  file=sys.stderr)
+    return record
+
+
+def bench_pipe_moe(n_dev, micro=4, steps=3):
+    """Pipeline x MoE composition rung (round 25): the interleaved 1F1B
+    machine with 8 experts through the meshless dropless pallas dispatch
+    — the ONE legal pipeline MoE dataflow — against the single-device
+    run of the identical per-micro objective (CE + aux, f32). The
+    parity bit is the record's point; tokens/s/chip rides along as the
+    observable. A buffer dispatch leaking in shows up as an hlolint
+    a2a-free violation (pipe_moe world), not here."""
+    import jax.numpy as jnp
+
+    from tools.bench_ladder import make_batch, setup_step, time_windows
+    from tpukit.mesh import create_mesh
+    from tpukit.model import GPTConfig
+    from tpukit.pipeline import Pipeline1F1B
+    from tpukit.shardings import SingleDevice
+
+    stages = 2
+    if n_dev < stages:
+        raise ValueError("pipe_moe rung needs >= 2 devices")
+    seq = 64
+    cfg_m = GPTConfig(
+        dim=64, head_dim=16, heads=4, num_layers=8, vocab_size=1024,
+        max_position_embeddings=seq, compute_dtype=jnp.float32,
+        num_experts=8, moe_dispatch="pallas", virtual_stages=2,
+    )
+    batch = 2 * micro
+    b, t = make_batch(np.random.RandomState(5), cfg_m.vocab_size, batch, seq)
+
+    # single-device reference: same params (same init key), same
+    # objective — the pipeline's per-micro CE+aux at f32 must match to
+    # float tolerance
+    step_ref, state_ref, _, _ = setup_step(
+        cfg_m.replace(virtual_stages=1), SingleDevice()
+    )
+    state_ref, ref_loss = step_ref(state_ref, b, t)
+    ref_loss = float(ref_loss)
+    del state_ref
+
+    strat = Pipeline1F1B(
+        create_mesh({"stage": stages}), num_microbatches=micro,
+        moe_dispatch="pallas",
+    )
+    step, state, _, _ = setup_step(cfg_m, strat)
+    state, loss = step(state, b, t)
+    loss = float(loss)
+    times, state, _ = time_windows(
+        step, state, b, t, steps=steps, windows=2, warmup=1
+    )
+    del state
+    delta = abs(loss - ref_loss)
+    return {
+        "stages": stages,
+        "virtual_stages": 2,
+        "microbatches": micro,
+        "num_experts": 8,
+        "dispatch": "pallas",
+        "loss": round(loss, 6),
+        "ref_loss": round(ref_loss, 6),
+        "loss_delta": round(delta, 8),
+        "parity_ok": bool(delta < 1e-4),
+        "tokens_per_sec_per_chip": round(
+            steps * batch * seq / min(times) / stages, 1
+        ),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -1804,6 +1978,23 @@ def main(argv=None):
         comm_overlap_rec = [{"strategy": "comm_overlap", "error": repr(exc)}]
         print(f"comm overlap ladder failed: {exc!r}", file=sys.stderr)
 
+    # Interleaved pipeline (round 25, --virtual_stages): flat 1F1B vs
+    # V=2/V=4 at equal micro count — the tick-table bubble grid (the
+    # --min_bubble_gain gated numbers) plus wall cross-checks; and the
+    # pipeline x MoE pallas-dispatch parity rung.
+    pipe_interleave_rec = None
+    try:
+        pipe_interleave_rec = bench_pipe_interleave(n_dev)
+    except Exception as exc:
+        pipe_interleave_rec = {"error": repr(exc)}
+        print(f"pipe interleave ladder failed: {exc!r}", file=sys.stderr)
+    pipe_moe_rec = None
+    try:
+        pipe_moe_rec = bench_pipe_moe(n_dev)
+    except Exception as exc:
+        pipe_moe_rec = {"error": repr(exc)}
+        print(f"pipe moe probe failed: {exc!r}", file=sys.stderr)
+
     # Elastic restore (round 13, ROADMAP #5): restore+reshard wall-clock,
     # bytes read, RSS high-water delta and the parity bit for a sharded
     # checkpoint landing on a half-size world.
@@ -1956,6 +2147,8 @@ def main(argv=None):
         "moe_dispatch_ladder": moe_dispatch_ladder,
         "quant_comm": quant_comm_rec,
         "comm_overlap": comm_overlap_rec,
+        "pipe_interleave": pipe_interleave_rec,
+        "pipe_moe": pipe_moe_rec,
         "elastic_restore": elastic_restore,
         "serving": serving_rec,
         "paged_kv": paged_kv_rec,

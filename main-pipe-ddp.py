@@ -35,12 +35,18 @@ def pick_grid(n_devices: int, num_layers: int) -> dict:
 
 
 def main(argv=None):
-    flags = parse_flags(argv, pipeline_schedule=True)
+    flags = parse_flags(
+        argv, pipeline_schedule=True, num_experts=True, default_experts=0
+    )
     cls = Pipeline1F1B if flags.pipeline_schedule == "1f1b" else Pipeline
     grid = pick_grid(len(jax.devices()), flags.num_layers)
     return fit(
         flags,
-        cls(create_mesh(grid), num_microbatches=flags.microbatches or "4x"),
+        cls(
+            create_mesh(grid),
+            num_microbatches=flags.microbatches or "4x",
+            moe_dispatch=flags.moe_dispatch if flags.num_experts else None,
+        ),
     )
 
 

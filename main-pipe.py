@@ -17,6 +17,15 @@ stage count defaults to the device count (twin of
 `num_stages = torch.cuda.device_count()`, main-pipe.py:93) and micro-batch
 count equals stage count (`chunks=num_stages`, main-pipe.py:83).
 
+Interleaved virtual stages (round 22): `--pipeline_schedule 1f1b
+--virtual_stages V` splits each device's layer block into V non-contiguous
+chunks (device d owns chunks d, d+S, ..., d+(V-1)S), shrinking the
+warm-up/cool-down bubble toward (S-1)/(M*V) at the same micro-batch count
+(bench.py `pipe_interleave` measures it). MoE rides along: `--num_experts 8
+--moe_dispatch pallas` runs the meshless dropless dispatch inside each
+stage's chunks — the buffer dispatches ('xla'/'a2a') need an expert mesh
+axis the pipeline does not carry and are rejected by name.
+
 Run: `python main-pipe.py --batch_size 64 --num_layers 8 ...`
 (num_layers must divide by the stage count).
 """
@@ -27,11 +36,19 @@ from tpukit.train import fit
 
 
 def main(argv=None):
-    flags = parse_flags(argv, pipeline_schedule=True)
+    flags = parse_flags(
+        argv, pipeline_schedule=True, num_experts=True, default_experts=0
+    )
     cls = Pipeline1F1B if flags.pipeline_schedule == "1f1b" else Pipeline
     # 4x micro-batches per stage shrink the GPipe bubble (divergence from
     # the reference's chunks=num_stages; --microbatches N restores it)
-    return fit(flags, cls(num_microbatches=flags.microbatches or "4x"))
+    return fit(
+        flags,
+        cls(
+            num_microbatches=flags.microbatches or "4x",
+            moe_dispatch=flags.moe_dispatch if flags.num_experts else None,
+        ),
+    )
 
 
 if __name__ == "__main__":

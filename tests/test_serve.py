@@ -183,7 +183,7 @@ def test_engine_evicts_on_eos_and_reuses_slot(tok, cfg, params):
     )
     # the single slot was reused for rid 1, which retires on length
     assert by_rid[1].reason == "length" and by_rid[1].generated == 2
-    assert eng.evicted == {"eos": 1, "length": 1}
+    assert eng.evicted == {"eos": 1, "length": 1, "deadline": 0}
     assert list(eng._free) == [0]
 
 

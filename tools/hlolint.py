@@ -83,6 +83,12 @@ WORLDS = (
     # collectives must be attributed ONCE by the body-membership parser,
     # so the per-step plan gates any window size)
     "paged_fused", "sched_loop",
+    # round 22 (--virtual_stages): the interleaved 1F1B machine (V=2
+    # chunks per device) on a data x stage grid — unrolled static ticks,
+    # so the plan's collective-permute count is EXACT; pipe_moe runs the
+    # meshless pallas dispatch inside the chunks and its plan pins
+    # all-to-all to ZERO (the a2a-free guard)
+    "pipe_interleave", "pipe_moe",
 )
 
 # the golden-fixture subset checked into tests/fixtures/hlo/ (ISSUE 12);
@@ -93,6 +99,7 @@ FIXTURE_WORLDS = (
     "ep_a2a", "tp_decode", "paged_decode",
     "ddp_overlap", "fsdp_overlap",
     "paged_fused", "sched_loop",
+    "pipe_interleave", "pipe_moe",
 )
 
 
@@ -126,7 +133,36 @@ def _train_world(name: str, n_devices: int) -> dict:
     # layer-reversed grad buckets (EP: per-layer exchange, audit declared)
     overlap = name.endswith("overlap")
     comm = "f32" if name.endswith("f32") or name == "ep_a2a" else "int8"
-    if name.startswith("ep"):
+    if name.startswith("pipe"):
+        # round 22: interleaved 1F1B — V=2 virtual chunks per device on a
+        # (data, stage) grid, 8 layers so each chunk holds exactly one.
+        # The machine is UNROLLED (no scan), so the compiled module's
+        # collective-permute population must equal the schedule's ship
+        # count (Pipeline1F1B.pipe_comm) — the plan diff is exact, not a
+        # bound. pipe_moe swaps in 4 experts through the meshless pallas
+        # dispatch; its plan also pins all-to-all to ZERO so any buffer
+        # dispatch leaking in trips the a2a-free guard.
+        from tpukit.pipeline import Pipeline1F1B
+
+        if n_devices % 4:
+            raise SystemExit(f"world {name} needs a multiple of 4 devices")
+        cfg = _dryrun_cfg(
+            num_experts=4 if name == "pipe_moe" else 0,
+        ).replace(num_layers=8, virtual_stages=2)
+        if name == "pipe_moe":
+            # STAGE-ONLY mesh: with a data axis GSPMD reshards the batch
+            # ingest through tiny s32/pred all-to-alls, which would drown
+            # the guard; on stages alone, all-to-all x0 is exact.
+            strategy = Pipeline1F1B(
+                create_mesh({"stage": 4}, devices[:4]),
+                num_microbatches=4, moe_dispatch="pallas",
+            )
+        else:
+            strategy = Pipeline1F1B(
+                create_mesh({"data": n_devices // 4, "stage": 4}, devices),
+                num_microbatches=4,
+            )
+    elif name.startswith("ep"):
         if inner <= 1:
             raise SystemExit(f"world {name} needs a composite device count")
         cfg = _dryrun_cfg(

@@ -54,7 +54,13 @@ diffs two runs' metric summaries (per-histogram p50/p99 deltas plus the
 tokens/s headline); the `--min_slo_compliance` and
 `--max_regression_pct` gates CI them; bench.py's `metrics_overhead`
 record (pure-observer proof: token parity + <1% throughput) renders
-too. The accreted per-gate argparse/dispatch boilerplate is
+too. Round-25 interleaved pipelines add bench.py's `pipe_interleave`
+record (the tick-table bubble grid for V virtual stages per device plus
+wall cross-checks) and `pipe_moe` (pipeline x pallas-dispatch MoE loss
+parity), rendered as "== pipeline ==" sections and gated by
+`--min_bubble_gain` — the grid is deterministic schedule accounting, so
+the gate transfers from CPU. The accreted per-gate argparse/dispatch
+boilerplate is
 consolidated into the declarative GATES table below — one row per gate,
 checker functions unchanged. This tool needs NOTHING but
 the file — no jax import, so it runs anywhere the log was copied to.
@@ -64,6 +70,7 @@ Usage: python tools/report.py run.jsonl [--min_goodput 0.8]
                                         [--min_accept_rate 0.3]
                                         [--min_trace_complete 1.0]
                                         [--min_decode_speedup 1.0]
+                                        [--min_bubble_gain 0.5]
                                         [--min_slo_compliance 0.99]
                                         [--compare baseline.jsonl]
                                         [--max_regression_pct 10]
@@ -801,6 +808,57 @@ def summarize(records: list[dict]) -> str:
               + ("" if match is None
                  else ("   audit OK" if match else "   audit <- MISMATCH"))
               + ("" if not warns else f"   remat warnings {warns}!"))
+    # round-25 interleaved pipeline (--virtual_stages): the tick-table
+    # bubble grid (the gated, backend-free numbers) plus the timed rungs'
+    # wall cross-check, and the pipeline x MoE pallas parity rung.
+    for r in records:
+        pi = r.get("pipe_interleave")
+        if not isinstance(pi, dict):
+            continue
+        w("== pipeline (bench, --virtual_stages) ==")
+        if "error" in pi:
+            w(f"  ERROR {pi['error']}")
+            continue
+        w(f"  stages {pi.get('stages', '?')}  microbatches "
+          f"{pi.get('microbatches', '?')}  layers {pi.get('layers', '?')}")
+        by_m: dict = {}
+        for row in pi.get("bubble_table") or []:
+            by_m.setdefault(row.get("micro"), []).append(row)
+        for m, rows_m in sorted(by_m.items()):
+            cells = " -> ".join(
+                f"V{row['virtual_stages']} {row['bubble_frac']:.3f}"
+                for row in sorted(rows_m,
+                                  key=lambda x: x["virtual_stages"]))
+            w(f"  bubble @M={m}: {cells}")
+        for row in pi.get("rungs") or []:
+            if "error" in row:
+                w(f"  V={row.get('virtual_stages', '?')}  ERROR "
+                  f"{row['error']}")
+                continue
+            wall = row.get("wall_ratio_vs_flat")
+            w(f"  V={row['virtual_stages']}  bubble "
+              f"{row.get('bubble_frac', 0):.3f}   predicted "
+              f"{row.get('predicted_ratio_vs_flat', 0) * 100:.1f}% of flat"
+              + (f"   wall {wall * 100:.1f}%" if wall is not None else "")
+              + f"   {human_count(row.get('tokens_per_sec_per_chip'))} "
+              f"tok/s/chip")
+        if pi.get("caveat"):
+            w(f"  caveat: {pi['caveat']}")
+    for r in records:
+        pm = r.get("pipe_moe")
+        if not isinstance(pm, dict):
+            continue
+        w("== pipeline x moe (bench, --moe_dispatch pallas) ==")
+        if "error" in pm:
+            w(f"  ERROR {pm['error']}")
+            continue
+        w(f"  {pm.get('stages', '?')} stages x V={pm.get('virtual_stages', '?')}"
+          f" M={pm.get('microbatches', '?')}, e{pm.get('num_experts', '?')} "
+          f"{pm.get('dispatch', '?')} dispatch: "
+          f"{human_count(pm.get('tokens_per_sec_per_chip'))} tok/s/chip")
+        w(f"  loss parity vs single device: {pm.get('loss', '?')} vs "
+          f"{pm.get('ref_loss', '?')} (delta {pm.get('loss_delta', '?')})"
+          + ("  OK" if pm.get("parity_ok") else "  <- MISMATCH"))
     # round-13 elastic restore (ROADMAP #5): what a reshard-on-restore
     # relaunch costs — wall-clock, bytes read, host RSS high-water delta,
     # and the byte-parity bit vs a direct restore. Rendered under the
@@ -1259,6 +1317,62 @@ def check_min_decode_speedup(records: list[dict],
                    "(did the bench run the fused rungs?)")
 
 
+def check_min_bubble_gain(records: list[dict],
+                          threshold: float) -> tuple[bool, str]:
+    """Interleaved-pipeline gate (`--min_bubble_gain`, round 25): the
+    bench `pipe_interleave` record's bubble grid must show, at EVERY
+    micro-batch count, (a) a strictly decreasing bubble fraction as
+    virtual stages grow (1 -> 2 -> 4) and (b) a relative bubble cut
+    (1 - bubble[max V]/bubble[V=1]) >= `threshold`. The grid is
+    tick-table accounting, deterministic on any backend — the wall
+    numbers stay informational (CPU loopback, the --min_overlap_frac
+    discipline) — but every TIMED rung must also have run without
+    error, so a machine that stopped compiling cannot pass on pure
+    math. A log without the record fails: dropping the rung from the
+    bench invocation must not pass the gate vacuously."""
+    for r in records:
+        pi = r.get("pipe_interleave")
+        if not isinstance(pi, dict):
+            continue
+        if "error" in pi:
+            return False, f"--min_bubble_gain FAIL: record errored: {pi['error']}"
+        broken = [
+            f"V={row.get('virtual_stages', '?')}: {row['error']}"
+            for row in pi.get("rungs") or [] if "error" in row
+        ]
+        if broken:
+            return False, ("--min_bubble_gain FAIL: errored timed rung(s): "
+                           + "; ".join(broken))
+        by_m: dict = {}
+        for row in pi.get("bubble_table") or []:
+            by_m.setdefault(row.get("micro"), []).append(row)
+        if not by_m:
+            return False, ("--min_bubble_gain FAIL: record carries no "
+                           "bubble_table grid")
+        worst = None  # (gain, micro, fracs)
+        for m, rows_m in sorted(by_m.items()):
+            rows_m = sorted(rows_m, key=lambda x: x["virtual_stages"])
+            fracs = [row["bubble_frac"] for row in rows_m]
+            if any(b >= a for a, b in zip(fracs, fracs[1:])):
+                return False, (
+                    f"--min_bubble_gain FAIL: bubble fraction not strictly "
+                    f"decreasing at M={m}: "
+                    + " -> ".join(f"{f:.4f}" for f in fracs))
+            gain = 1.0 - fracs[-1] / fracs[0]
+            if worst is None or gain < worst[0]:
+                worst = (gain, m, fracs)
+        ok = worst[0] >= threshold
+        verdict = "OK" if ok else "FAIL"
+        return ok, (
+            f"--min_bubble_gain {verdict}: min relative bubble cut "
+            f"{worst[0]:.3f} at M={worst[1]} "
+            f"({worst[2][0]:.4f} -> {worst[2][-1]:.4f}) over "
+            f"{len(by_m)} micro counts (threshold {threshold:.3f})"
+        )
+    return False, ("--min_bubble_gain: no pipe_interleave record in the log "
+                   "(did the bench run the interleave rungs?)")
+
+
 # ---- round-22 cross-run comparison (--compare baseline.jsonl) ------------
 
 
@@ -1486,6 +1600,12 @@ GATES: tuple = (
      "(on-device scheduler loop vs per-step dispatch) >= RATIO with "
      "token parity intact (exit 2 below it, or when the log has no "
      "decode_fused record) — the round-21 fused-decode regression gate"),
+    ("min_bubble_gain", "FRACTION", check_min_bubble_gain,
+     "assert the pipe_interleave bench record's relative bubble cut "
+     "(1 - bubble[max V]/bubble[V=1], tick-table accounting) >= FRACTION "
+     "at EVERY micro count, strictly decreasing in V, with no errored "
+     "timed rung (exit 2 otherwise, or when the log has no "
+     "pipe_interleave record) — the round-25 interleaved-pipeline gate"),
     ("min_slo_compliance", "FRACTION", check_min_slo_compliance,
      "assert the run's cumulative SLO compliance (worst target in the "
      "last kind=\"slo\" record) >= FRACTION (exit 2 below it, or when "

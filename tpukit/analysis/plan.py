@@ -125,6 +125,22 @@ def train_comm_plan(strategy, cfg, *, param_shapes=None, global_batch=None,
                 elif comm != "f32":
                     wire["all-to-all"] = _wire_dtype_of(comm)
 
+    pipe_fn = getattr(strategy, "pipe_comm", None)
+    if pipe_fn is not None and global_batch is not None and seq is not None:
+        # Interleaved pipeline schedules (round 22): the unrolled tick
+        # machine's shipping ticks are static, so the strategy states the
+        # exact collective-permute count/bytes of the compiled step; MoE
+        # worlds also pin all-to-all to ZERO (the pallas dispatch is
+        # collective-free — a surplus a2a means the buffer dataflow leaked
+        # in). None for the flat V=1 scan, whose hops live inside one scan
+        # body instruction.
+        pexp = pipe_fn(cfg, global_batch=global_batch, seq=seq, phase=phase)
+        if pexp:
+            for op, rec in pexp.items():
+                dst = ops.setdefault(op, {"count": 0, "bytes": 0})
+                dst["count"] += rec["count"]
+                dst["bytes"] += rec["bytes"]
+
     if not ops:
         return None
     # --grad_buckets overlap declaration (train phase only — eval has no

@@ -176,9 +176,20 @@ class TrainFlags:
     # embeddings/head) or "1f1b" (explicit per-stage vjps — activation
     # memory bounded by the stage count instead of the micro count).
     pipeline_schedule: str = "gpipe"
-    # main-moe.py only: number of routed experts replacing each layer's FFN
-    # (0 = the dense reference model) and how many experts each token
-    # routes to (1 = Switch, 2 = GShard/Mixtral-style top-2).
+    # pipeline recipes only (round 22, ROADMAP #5): interleaved virtual
+    # stages for the 1f1b schedule — device d owns V non-contiguous layer
+    # chunks (d, d+S, d+2S, ...) and the tick table interleaves their
+    # forward/backward micro-steps, shrinking the warm-up/cool-down bubble
+    # toward (S-1)/(M*V) at equal micro count. 1 = the existing schedules,
+    # byte-identical HLO; needs --schedule 1f1b and num_layers >= V*S.
+    virtual_stages: int = 1
+    # main-moe.py AND (round 22) the pipeline recipes: number of routed
+    # experts replacing each layer's FFN (0 = the dense reference model)
+    # and how many experts each token routes to (1 = Switch, 2 =
+    # GShard/Mixtral-style top-2). Under the pipeline recipes the expert
+    # FFN rides INSIDE a stage chunk and only the meshless
+    # --moe_dispatch pallas dataflow is legal (no a2a axis on a stage
+    # mesh); xla/a2a are rejected by name at validate_config.
     num_experts: int = 0
     moe_top_k: int = 1
     # main-moe.py only: expert dispatch dataflow (round 10/11). "a2a"
@@ -239,6 +250,7 @@ def build_parser(
     cp_attention: bool = False,
     pipeline_schedule: bool = False,
     num_experts: bool = False,
+    default_experts: int = 8,
 ) -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser()
     defaults = TrainFlags()
@@ -257,8 +269,14 @@ def build_parser(
             "--schedule", dest="pipeline_schedule",
             choices=("gpipe", "1f1b"), default="gpipe",
         )
+        parser.add_argument(
+            "--virtual_stages", type=int, default=defaults.virtual_stages
+        )
     if num_experts:
-        parser.add_argument("--num_experts", type=int, default=8)
+        # main-moe.py keeps its 8-expert default; the pipeline recipes opt
+        # in with default_experts=0 so `main-pipe.py` stays the dense
+        # reference unless --num_experts is passed explicitly
+        parser.add_argument("--num_experts", type=int, default=default_experts)
         parser.add_argument("--moe_top_k", type=int, default=1)
         parser.add_argument(
             "--moe_dispatch", choices=("a2a", "xla", "pallas"), default="a2a"
@@ -335,17 +353,20 @@ def parse_flags(
     cp_attention: bool = False,
     pipeline_schedule: bool = False,
     num_experts: bool = False,
+    default_experts: int = 8,
 ) -> TrainFlags:
     ns = build_parser(
         cpu_offload=cpu_offload,
         cp_attention=cp_attention,
         pipeline_schedule=pipeline_schedule,
         num_experts=num_experts,
+        default_experts=default_experts,
     ).parse_args(argv)
     kw = vars(ns)
     kw.setdefault("cpu_offload", False)
     kw.setdefault("cp_attention", "ring")
     kw.setdefault("pipeline_schedule", "gpipe")
+    kw.setdefault("virtual_stages", 1)
     kw.setdefault("num_experts", 0)
     kw.setdefault("moe_top_k", 1)
     kw.setdefault("moe_dispatch", "a2a")

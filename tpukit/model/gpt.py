@@ -185,6 +185,17 @@ class GPTConfig:
     # dataflow. Strategies without a hand-placed grad wire reject N > 0
     # at validate_config.
     grad_buckets: int = 0
+    # Interleaved virtual pipeline stages (round 22, ROADMAP #5 —
+    # tpukit/pipeline.py Pipeline1F1B + tpukit/pipeline_schedule.py).
+    # 1 (default): each pipeline device owns ONE contiguous layer block —
+    # the existing GPipe/1F1B schedules, byte-identical HLO. V > 1: device
+    # d owns V non-contiguous chunks (global chunks d, d+S, d+2S, ... of
+    # the layer stack), and the 1F1B tick machine runs a static interleaved
+    # tick table (Megatron-LM's interleaved 1F1B) so the warm-up/cool-down
+    # bubble shrinks toward (S-1)/(M*V) at equal micro count M. Only the
+    # explicit-vjp 1f1b schedule interleaves; Pipeline (GPipe) rejects
+    # V > 1 at validate_config with a named error.
+    virtual_stages: int = 1
     # Fused paged decode (round 21, ROADMAP #3 — tpukit/ops/
     # paged_attention.py). False (default): the paged decode path keeps
     # its per-layer gather_view + _attend_over_cache trace byte-unchanged.
@@ -218,6 +229,12 @@ class GPTConfig:
             raise ValueError(
                 f"moe_dispatch={self.moe_dispatch!r} must be 'xla', 'a2a' "
                 f"or 'pallas'"
+            )
+        if self.virtual_stages < 1:
+            raise ValueError(
+                f"virtual_stages={self.virtual_stages} must be >= 1 (1 = "
+                f"one contiguous layer block per pipeline stage, V > 1 = "
+                f"interleaved chunks under the 1f1b schedule)"
             )
 
     @property

@@ -398,6 +398,15 @@ class Strategy:
         validating an int8 config."""
         return self.comm_ops
 
+    def inference_params(self, params, cfg: gpt.GPTConfig):
+        """Params as the plain sequential `gpt.forward` expects them.
+        Identity for every strategy whose training layout IS the natural
+        layout; the interleaved pipeline (cfg.virtual_stages > 1) stores
+        the layer stack chunk-permuted and overrides this to restore
+        natural layer order before generation/decode (train.py's
+        generate_samples calls it after replication)."""
+        return params
+
     @property
     def batch_divisor(self) -> int:
         """Every global batch fed to this strategy must be a multiple of this.

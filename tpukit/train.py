@@ -319,6 +319,10 @@ def generate_samples(
     rank 0 computes" (a deadlock for sharded state) to "all compute, rank 0
     prints"."""
     params = replicated_params(strategy, state)
+    # Strategies that train on a re-laid-out param tree (the interleaved
+    # pipeline stores the layer stack chunk-permuted) restore the natural
+    # layer order for the plain sequential decode; identity for the rest.
+    params = strategy.inference_params(params, cfg)
     # ONE batched jitted call (VERDICT r4 #7): one compile and one decode
     # per epoch instead of a serial compile+decode per prompt — `generate`
     # stays as the single-prompt API.
@@ -472,6 +476,7 @@ def _fit_body(
         scan_layers=flags.scan_layers,
         num_experts=flags.num_experts,
         router_top_k=flags.moe_top_k,
+        virtual_stages=flags.virtual_stages,
         comm_dtype=flags.comm_dtype,
         quant_stochastic=flags.quant_stochastic,
         grad_buckets=flags.grad_buckets,
